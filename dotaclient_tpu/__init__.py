@@ -1,0 +1,20 @@
+"""dotaclient_tpu — a TPU-native self-play deep-RL framework.
+
+A ground-up JAX/XLA/Pallas rebuild of the capability surface of
+``Nostrademous/dotaclient`` (PyTorch actor-learner PPO for Dota 2):
+
+- ``protos``    first-party wire format (worldstate / actions / rollouts)
+- ``envs``      lane simulator + gRPC environment service and client
+- ``features``  worldstate -> fixed-shape arrays; action codec
+- ``models``    Flax policy: unit encoders, LSTM(128) core, masked heads
+- ``ops``       GAE, masked distributions, Pallas kernels
+- ``train``     pjit'd PPO train step and learner loop
+- ``buffer``    sharded HBM-resident trajectory ring buffer
+- ``transport`` experience/weight transport (in-proc queue, AMQP interface)
+- ``actor``     batched-on-device actor runtime multiplexing many envs
+- ``league``    self-play opponent pools and evaluation
+- ``parallel``  mesh construction, sharding rules, sequence parallelism
+- ``utils``     checkpointing, metrics, profiling
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,153 @@
+"""Frozen dataclass configuration tree.
+
+The reference spreads configuration across per-entrypoint ``argparse`` flags
+plus the ``GameConfig`` proto (SURVEY.md §5.6). Here the whole system is
+configured by one immutable tree that is serialized into checkpoints; the
+``GameConfig`` proto survives only at the environment boundary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Mapping, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ObsSpec:
+    """Fixed-shape observation layout (TPU-critical: no shape depends on the
+    live unit count — SURVEY.md §7 step 2)."""
+
+    max_units: int = 32          # padded unit slots per observation
+    unit_features: int = 22      # per-unit feature vector length
+    global_features: int = 8     # game-time, team, gold/xp diffs, ...
+    max_abilities: int = 4       # ability slots exposed per hero
+
+
+@dataclasses.dataclass(frozen=True)
+class ActionSpec:
+    """Discrete multi-head action space (reference head set, SURVEY.md §3.3)."""
+
+    n_action_types: int = 4      # noop / move / attack-unit / cast
+    move_bins: int = 9           # discretized move offsets per axis
+    max_units: int = 32          # target-unit head size == padded unit slots
+    max_abilities: int = 4
+
+    @property
+    def head_sizes(self) -> Mapping[str, int]:
+        return {
+            "action_type": self.n_action_types,
+            "move_x": self.move_bins,
+            "move_y": self.move_bins,
+            "target_unit": self.max_units,
+            "ability": self.max_abilities,
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Flax policy hyper-parameters (LSTM(128) core per BASELINE.json:7)."""
+
+    unit_embed_dim: int = 64
+    hidden_dim: int = 128        # LSTM hidden size — parity with reference
+    n_hero_ids: int = 32         # hero-embedding vocabulary (multi-hero pools)
+    hero_embed_dim: int = 16
+    core: str = "lstm"           # "lstm" | "transformer"
+    # Transformer-core options (scale-out path, SURVEY.md §7 step 8).
+    n_layers: int = 2
+    n_heads: int = 4
+    dtype: str = "bfloat16"      # compute dtype; params stay float32
+    param_dtype: str = "float32"
+
+
+@dataclasses.dataclass(frozen=True)
+class PPOConfig:
+    gamma: float = 0.99
+    gae_lambda: float = 0.95
+    clip_eps: float = 0.2
+    value_coef: float = 0.5
+    entropy_coef: float = 0.01
+    learning_rate: float = 3e-4
+    max_grad_norm: float = 0.5
+    rollout_len: int = 16        # truncated-BPTT chunk length T
+    batch_rollouts: int = 32     # rollouts per optimizer step (B)
+    epochs_per_batch: int = 1
+    max_staleness: int = 4       # drop rollouts older than this many versions
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvConfig:
+    n_envs: int = 8
+    ticks_per_observation: int = 6
+    max_dota_time: float = 600.0
+    hero_pool: Tuple[int, ...] = (1,)   # hero ids agents may draft from
+    team_size: int = 1                  # 1 => 1v1, 2 => 2v2, 5 => 5v5
+    opponent: str = "scripted_easy"     # scripted_easy | scripted_hard | league
+    seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device mesh layout. Axes: data (batch/grad psum), model (TP)."""
+
+    data_axis: str = "data"
+    model_axis: str = "model"
+    data_parallel: int = -1      # -1 => all remaining devices
+    model_parallel: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class BufferConfig:
+    capacity_rollouts: int = 256   # ring-buffer slots (sharded over data axis)
+    min_fill: int = 32             # rollouts required before first train step
+
+
+@dataclasses.dataclass(frozen=True)
+class LeagueConfig:
+    enabled: bool = False
+    pool_size: int = 8
+    snapshot_every: int = 200      # learner steps between opponent snapshots
+    selfplay_prob: float = 0.5     # chance of facing the latest policy
+
+
+@dataclasses.dataclass(frozen=True)
+class RunConfig:
+    """Top-level config tree."""
+
+    obs: ObsSpec = ObsSpec()
+    actions: ActionSpec = ActionSpec()
+    model: ModelConfig = ModelConfig()
+    ppo: PPOConfig = PPOConfig()
+    env: EnvConfig = EnvConfig()
+    mesh: MeshConfig = MeshConfig()
+    buffer: BufferConfig = BufferConfig()
+    league: LeagueConfig = LeagueConfig()
+    checkpoint_dir: str = "checkpoints"
+    checkpoint_every: int = 100
+    log_every: int = 10
+    seed: int = 0
+
+    def replace(self, **kwargs: Any) -> "RunConfig":
+        return dataclasses.replace(self, **kwargs)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "RunConfig":
+        raw = json.loads(text)
+        return cls(
+            obs=ObsSpec(**raw["obs"]),
+            actions=ActionSpec(**raw["actions"]),
+            model=ModelConfig(**raw["model"]),
+            ppo=PPOConfig(**raw["ppo"]),
+            env=EnvConfig(**{**raw["env"], "hero_pool": tuple(raw["env"]["hero_pool"])}),
+            mesh=MeshConfig(**raw["mesh"]),
+            buffer=BufferConfig(**raw["buffer"]),
+            league=LeagueConfig(**raw["league"]),
+            **{k: raw[k] for k in ("checkpoint_dir", "checkpoint_every", "log_every", "seed")},
+        )
+
+
+def default_config() -> RunConfig:
+    return RunConfig()

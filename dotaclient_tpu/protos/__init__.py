@@ -1,0 +1,7 @@
+"""Generated protobuf bindings for the first-party wire format.
+
+Regenerate with:
+    protoc --python_out=dotaclient_tpu/protos -I dotaclient_tpu/protos \
+        dotaclient_tpu/protos/dota.proto
+"""
+from dotaclient_tpu.protos import dota_pb2  # noqa: F401
