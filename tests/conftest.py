@@ -1,0 +1,16 @@
+"""Test harness configuration.
+
+Forces JAX onto the host CPU platform with 8 virtual devices *before* jax is
+imported anywhere, so every sharding/collective test runs the same way the
+driver's multi-chip dry-run does (SURVEY.md §4 "Distributed-without-a-
+cluster") and the real TPU chip is never contended by the test suite.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,71 @@
+"""gRPC env service: full reset/observe/act cycle over a real localhost socket."""
+
+import asyncio
+
+import grpc
+import pytest
+
+from dotaclient_tpu.envs import lane_sim, service
+from dotaclient_tpu.protos import dota_pb2 as pb
+
+
+def _config():
+    return pb.GameConfig(
+        ticks_per_observation=6, max_dota_time=120.0, seed=3,
+        hero_picks=[
+            pb.HeroPick(team_id=lane_sim.TEAM_RADIANT, hero_id=1,
+                        control_mode=pb.CONTROL_AGENT),
+            pb.HeroPick(team_id=lane_sim.TEAM_DIRE, hero_id=1,
+                        control_mode=pb.CONTROL_SCRIPTED_EASY),
+        ],
+    )
+
+
+def test_grpc_reset_observe_act_cycle():
+    async def main():
+        server, port = await service.serve_env()
+        client = service.DotaServiceClient.connect(f"127.0.0.1:{port}")
+        try:
+            init = await client.reset(_config())
+            assert init.status == pb.STATUS_OK
+            assert len(init.world_states) == 1
+            ws0 = init.world_states[0]
+            assert any(u.unit_type == pb.UNIT_HERO for u in ws0.units)
+
+            hero = next(u for u in ws0.units
+                        if u.unit_type == pb.UNIT_HERO
+                        and u.team_id == lane_sim.TEAM_RADIANT)
+            for _ in range(5):
+                await client.act(pb.Actions(
+                    team_id=lane_sim.TEAM_RADIANT,
+                    actions=[pb.Action(player_id=hero.player_id,
+                                       type=pb.ACTION_MOVE, move_x=8, move_y=4)],
+                ))
+            obs = await client.observe(lane_sim.TEAM_RADIANT)
+            assert obs.status == pb.STATUS_OK
+            hero_now = next(u for u in obs.world_state.units
+                            if u.player_id == hero.player_id)
+            assert hero_now.location.x > hero.location.x, "hero should have moved +x"
+
+            # second reset reuses the same server
+            init2 = await client.reset(_config())
+            assert init2.world_states[0].tick == 0
+        finally:
+            await client.close()
+            await server.stop(None)
+
+    asyncio.run(main())
+
+
+def test_grpc_observe_before_reset_fails_cleanly():
+    async def main():
+        server, port = await service.serve_env()
+        client = service.DotaServiceClient.connect(f"127.0.0.1:{port}")
+        try:
+            resp = await client.observe(lane_sim.TEAM_RADIANT)
+            assert resp.status == pb.STATUS_FAILED
+        finally:
+            await client.close()
+            await server.stop(None)
+
+    asyncio.run(main())
