@@ -1,0 +1,29 @@
+"""Featurization boundary: worldstate protos ↔ fixed-shape arrays."""
+
+from dotaclient_tpu.features.featurizer import (
+    GLOBAL_FEATURES,
+    Observation,
+    UNIT_FEATURES,
+    decode_action,
+    featurize,
+    observation_to_dict,
+    stack_observations,
+)
+from dotaclient_tpu.features.reward import (
+    WEIGHTS,
+    reward_components,
+    shaped_reward,
+)
+
+__all__ = [
+    "GLOBAL_FEATURES",
+    "Observation",
+    "UNIT_FEATURES",
+    "WEIGHTS",
+    "decode_action",
+    "featurize",
+    "observation_to_dict",
+    "reward_components",
+    "shaped_reward",
+    "stack_observations",
+]

@@ -1,0 +1,200 @@
+"""Featurizer + action-codec tests (SURVEY.md §4: golden tests on canned
+worldstates; Hypothesis property that illegal actions are never exposed)."""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from dotaclient_tpu.config import ActionSpec, ObsSpec
+from dotaclient_tpu.envs.lane_sim import LaneSim, TEAM_DIRE, TEAM_RADIANT
+from dotaclient_tpu.features import (
+    decode_action,
+    featurize,
+    shaped_reward,
+    stack_observations,
+)
+from dotaclient_tpu.protos import dota_pb2 as pb
+
+OBS = ObsSpec()
+ACT = ActionSpec()
+
+
+def make_sim(seed: int = 0, hard: bool = False) -> LaneSim:
+    mode = pb.CONTROL_SCRIPTED_HARD if hard else pb.CONTROL_SCRIPTED_EASY
+    cfg = pb.GameConfig(
+        ticks_per_observation=6,
+        seed=seed,
+        hero_picks=[
+            pb.HeroPick(team_id=TEAM_RADIANT, hero_id=1, control_mode=pb.CONTROL_AGENT),
+            pb.HeroPick(team_id=TEAM_DIRE, hero_id=2, control_mode=mode),
+        ],
+    )
+    return LaneSim(cfg)
+
+
+class TestShapes:
+    def test_fixed_shapes_regardless_of_unit_count(self):
+        sim = make_sim()
+        for _ in range(30):
+            ws = sim.world_state(TEAM_RADIANT)
+            obs = featurize(ws, player_id=0, obs_spec=OBS, action_spec=ACT)
+            assert obs.units.shape == (OBS.max_units, OBS.unit_features)
+            assert obs.unit_mask.shape == (OBS.max_units,)
+            assert obs.globals.shape == (OBS.global_features,)
+            assert obs.mask_action_type.shape == (ACT.n_action_types,)
+            assert obs.mask_target_unit.shape == (ACT.max_units,)
+            assert obs.units.dtype == np.float32
+            sim.step({})
+
+    def test_self_in_slot_zero(self):
+        sim = make_sim()
+        obs = featurize(sim.world_state(TEAM_RADIANT), 0, OBS, ACT)
+        is_self_col = list(__import__(
+            "dotaclient_tpu.features.featurizer", fromlist=["UNIT_FEATURES"]
+        ).UNIT_FEATURES).index("is_self")
+        assert obs.units[0, is_self_col] == 1.0
+        assert obs.unit_mask[0]
+        # self is never a legal target
+        assert not obs.mask_target_unit[0]
+
+    def test_stacking(self):
+        sim = make_sim()
+        obs = [
+            featurize(sim.world_state(TEAM_RADIANT), 0, OBS, ACT)
+            for _ in range(4)
+        ]
+        batch = stack_observations(obs)
+        assert batch["units"].shape == (4, OBS.max_units, OBS.unit_features)
+        assert batch["hero_id"].shape == (4,)
+
+    def test_finite(self):
+        sim = make_sim()
+        for _ in range(50):
+            obs = featurize(sim.world_state(TEAM_RADIANT), 0, OBS, ACT)
+            assert np.isfinite(obs.units).all()
+            assert np.isfinite(obs.globals).all()
+            sim.step({})
+
+
+class TestMasks:
+    def test_noop_always_legal(self):
+        sim = make_sim()
+        obs = featurize(sim.world_state(TEAM_RADIANT), 0, OBS, ACT)
+        assert obs.mask_action_type[pb.ACTION_NOOP]
+
+    def test_targets_are_valid_units(self):
+        sim = make_sim()
+        for _ in range(40):
+            ws = sim.world_state(TEAM_RADIANT)
+            obs = featurize(ws, 0, OBS, ACT)
+            alive = {u.handle for u in ws.units if u.is_alive}
+            for slot in np.flatnonzero(obs.mask_target_unit):
+                assert obs.unit_handles[slot] in alive
+            sim.step({})
+
+    def test_attack_mask_excludes_healthy_allied_creeps(self):
+        sim = make_sim()
+        ws = sim.world_state(TEAM_RADIANT)
+        obs = featurize(ws, 0, OBS, ACT)
+        by_handle = {u.handle: u for u in ws.units}
+        for slot in np.flatnonzero(obs.mask_target_unit):
+            u = by_handle[int(obs.unit_handles[slot])]
+            if u.team_id == TEAM_RADIANT:  # allied target ⇒ must be a deny
+                assert u.unit_type == pb.UNIT_LANE_CREEP
+                assert u.health < 0.5 * u.health_max
+
+    def test_dead_hero_can_only_noop(self):
+        sim = make_sim()
+        hero = sim.hero_for_player(0)
+        hero.alive = False
+        obs = featurize(sim.world_state(TEAM_RADIANT), 0, OBS, ACT)
+        assert obs.mask_action_type[pb.ACTION_NOOP]
+        assert not obs.mask_action_type[pb.ACTION_MOVE]
+        assert not obs.mask_action_type[pb.ACTION_ATTACK_UNIT]
+        assert not obs.mask_action_type[pb.ACTION_CAST]
+
+
+class TestCodec:
+    @settings(max_examples=50, deadline=None)
+    @given(seed=st.integers(0, 1000), steps=st.integers(0, 20), data=st.data())
+    def test_any_legal_action_decodes_and_applies(self, seed, steps, data):
+        """Property: any action drawn from the legal masks decodes into a
+        proto the sim accepts without error."""
+        sim = make_sim(seed=seed)
+        for _ in range(steps):
+            sim.step({})
+        ws = sim.world_state(TEAM_RADIANT)
+        obs = featurize(ws, 0, OBS, ACT)
+
+        legal_types = list(np.flatnonzero(obs.mask_action_type))
+        a_type = data.draw(st.sampled_from(legal_types))
+        indices = {
+            "action_type": int(a_type),
+            "move_x": data.draw(st.integers(0, ACT.move_bins - 1)),
+            "move_y": data.draw(st.integers(0, ACT.move_bins - 1)),
+            "target_unit": 0,
+            "ability": 0,
+        }
+        if a_type in (pb.ACTION_ATTACK_UNIT, pb.ACTION_CAST):
+            legal_targets = list(np.flatnonzero(obs.mask_target_unit))
+            indices["target_unit"] = int(data.draw(st.sampled_from(legal_targets)))
+        action = decode_action(indices, obs, player_id=0)
+        assert action.player_id == 0
+        if a_type in (pb.ACTION_ATTACK_UNIT, pb.ACTION_CAST):
+            assert action.target_handle > 0
+        sim.step({0: action})  # must not raise
+
+    def test_move_roundtrip(self):
+        sim = make_sim()
+        obs = featurize(sim.world_state(TEAM_RADIANT), 0, OBS, ACT)
+        action = decode_action(
+            {"action_type": pb.ACTION_MOVE, "move_x": 8, "move_y": 0,
+             "target_unit": 0, "ability": 0},
+            obs, player_id=0,
+        )
+        assert action.type == pb.ACTION_MOVE
+        assert (action.move_x, action.move_y) == (8, 0)
+
+
+class TestReward:
+    def test_zero_reward_on_identical_states(self):
+        sim = make_sim()
+        ws = sim.world_state(TEAM_RADIANT)
+        r, comps = shaped_reward(ws, ws, player_id=0)
+        assert r == pytest.approx(0.0)
+        assert all(v == pytest.approx(0.0) for v in comps.values())
+
+    def test_lasthit_gold_rewarded(self):
+        sim = make_sim()
+        prev = sim.world_state(TEAM_RADIANT)
+        hero = sim.hero_for_player(0)
+        hero.last_hits += 1
+        hero.gold += 40.0
+        cur = sim.world_state(TEAM_RADIANT)
+        r, comps = shaped_reward(prev, cur, player_id=0)
+        assert comps["last_hits"] > 0
+        assert comps["gold"] > 0
+        assert r > 0
+
+    def test_win_signal_symmetric(self):
+        sim = make_sim()
+        prev = sim.world_state(TEAM_RADIANT)
+        sim.game_state = pb.GAME_STATE_POST_GAME
+        sim.winning_team = TEAM_RADIANT
+        cur_r = sim.world_state(TEAM_RADIANT)
+        r_win, _ = shaped_reward(prev, cur_r, player_id=0)
+        sim.winning_team = TEAM_DIRE
+        cur_d = sim.world_state(TEAM_RADIANT)
+        r_loss, _ = shaped_reward(prev, cur_d, player_id=0)
+        assert r_win > 0 > r_loss
+
+    def test_death_penalized(self):
+        sim = make_sim()
+        prev = sim.world_state(TEAM_RADIANT)
+        hero = sim.hero_for_player(0)
+        hero.alive = False
+        hero.deaths += 1
+        cur = sim.world_state(TEAM_RADIANT)
+        r, comps = shaped_reward(prev, cur, player_id=0)
+        assert comps["deaths"] < 0
+        assert r < 0
