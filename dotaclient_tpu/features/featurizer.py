@@ -69,9 +69,13 @@ class Observation:
     globals: np.ndarray        # f32 [global_features]
     hero_id: np.ndarray        # i32 [] — controlled hero id (hero embedding)
     # Per-head legality masks (True == legal). Illegal actions must never be
-    # sampled; the policy applies these before softmax.
+    # sampled; the policy applies these before softmax. The target head has
+    # two masks because legality is conditional on the action type: ATTACK may
+    # hit any enemy or a deniable allied creep; CAST only enemies inside the
+    # nuke's range.
     mask_action_type: np.ndarray   # bool [n_action_types]
-    mask_target_unit: np.ndarray   # bool [max_units]
+    mask_target_unit: np.ndarray   # bool [max_units] — ATTACK targets
+    mask_cast_target: np.ndarray   # bool [max_units] — CAST targets
     mask_ability: np.ndarray       # bool [max_abilities]
 
 
@@ -94,10 +98,16 @@ def featurize(
 ) -> Observation:
     """Featurize ``world_state`` from ``player_id``'s perspective."""
     U, F = obs_spec.max_units, obs_spec.unit_features
+    if action_spec.max_units != U:
+        raise ValueError(
+            "ActionSpec.max_units must equal ObsSpec.max_units (the target "
+            f"head indexes unit slots): {action_spec.max_units} != {U}"
+        )
     units_arr = np.zeros((U, F), dtype=np.float32)
     unit_mask = np.zeros((U,), dtype=bool)
     unit_handles = np.zeros((U,), dtype=np.int32)
-    mask_target = np.zeros((action_spec.max_units,), dtype=bool)
+    mask_target = np.zeros((U,), dtype=bool)
+    mask_cast = np.zeros((U,), dtype=bool)
     mask_ability = np.zeros((action_spec.max_abilities,), dtype=bool)
 
     me: Optional[pb.Unit] = None
@@ -170,8 +180,9 @@ def featurize(
         if me_alive and attack_ok:
             mask_target[slot] = True
             any_attackable = True
-            if not is_ally and dist <= nuke_range:
-                any_nukable = True
+        if me_alive and not is_ally and dist <= nuke_range:
+            mask_cast[slot] = True
+            any_nukable = True
 
     # Global features from the self player's scoreboard entry.
     my_player: Optional[pb.Player] = None
@@ -226,6 +237,7 @@ def featurize(
         hero_id=np.asarray(me.hero_id if me is not None else 0, dtype=np.int32),
         mask_action_type=mask_action,
         mask_target_unit=mask_target,
+        mask_cast_target=mask_cast,
         mask_ability=mask_ability,
     )
 

@@ -1,0 +1,17 @@
+"""Policy models (Flax) and action distributions."""
+
+from dotaclient_tpu.models import distributions
+from dotaclient_tpu.models.policy import (
+    Policy,
+    dummy_obs_batch,
+    init_params,
+    make_policy,
+)
+
+__all__ = [
+    "Policy",
+    "distributions",
+    "dummy_obs_batch",
+    "init_params",
+    "make_policy",
+]

@@ -1,0 +1,159 @@
+"""Masked multi-head categorical action distribution.
+
+The reference samples one categorical per head, masks invalid actions before
+softmax, and sums per-head log-probs (SURVEY.md §3.3; reconstructed — the
+reference checkout was an empty mount). Here the joint log-prob is the
+*conditional* factorization: sub-heads only contribute when the sampled action
+type makes them relevant (move bins for MOVE, target slot for ATTACK/CAST,
+ability slot for CAST), so the surrogate ratio in PPO is exact.
+
+The target-unit head's legality is itself conditional on the action type
+(ATTACK may hit any enemy or a deniable allied creep; CAST only enemies in
+cast range), so it carries two masks and the log-softmax is selected by the
+sampled/stored action type — sampled actions are legal by construction, and
+the sim never has to silently drop one.
+
+All functions are shape-polymorphic over leading axes — they work for the
+actor's ``[B, ...]`` step and the learner's ``[B, T, ...]`` sequences alike —
+and are jit/vmap/grad-safe (no Python branching on data).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# Action-type enum values — must match protos (dota.proto ActionType).
+A_NOOP, A_MOVE, A_ATTACK, A_CAST = 0, 1, 2, 3
+
+# Large negative logit for illegal entries. Finite (not -inf) so that
+# fully-masked rows still produce finite softmax output under bf16/f32.
+NEG_INF = -1e9
+
+HEADS = ("action_type", "move_x", "move_y", "target_unit", "ability")
+
+
+def _safe_mask(mask: jnp.ndarray) -> jnp.ndarray:
+    """A mask with at least one legal entry per row.
+
+    A head can be entirely illegal (e.g. no attackable target) — it is then
+    never *used* (its action type is masked out too), but its log-softmax must
+    stay finite so `0 × logp` stays 0, not NaN. Fully-illegal rows fall back
+    to all-legal (uniform).
+    """
+    any_legal = jnp.any(mask, axis=-1, keepdims=True)
+    return jnp.where(any_legal, mask, True)
+
+
+def masked_log_softmax(logits: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    masked = jnp.where(_safe_mask(mask), logits, NEG_INF)
+    return jax.nn.log_softmax(masked, axis=-1)
+
+
+def _head_logps(
+    logits: Mapping[str, jnp.ndarray], obs: Mapping[str, jnp.ndarray]
+) -> Dict[str, jnp.ndarray]:
+    """Masked, normalized log-probs per head. The target head appears twice,
+    once per conditioning action type."""
+    ones = jnp.ones_like(logits["move_x"], dtype=bool)
+    return {
+        "action_type": masked_log_softmax(
+            logits["action_type"], obs["mask_action_type"]
+        ),
+        "move_x": masked_log_softmax(logits["move_x"], ones),
+        "move_y": masked_log_softmax(logits["move_y"], ones),
+        "target_attack": masked_log_softmax(
+            logits["target_unit"], obs["mask_target_unit"]
+        ),
+        "target_cast": masked_log_softmax(
+            logits["target_unit"], obs["mask_cast_target"]
+        ),
+        "ability": masked_log_softmax(logits["ability"], obs["mask_ability"]),
+    }
+
+
+def _select_target_logps(
+    logps: Mapping[str, jnp.ndarray], action_type: jnp.ndarray
+) -> jnp.ndarray:
+    """Per-row target-head log-softmax conditioned on the action type."""
+    is_cast = (action_type == A_CAST)[..., None]
+    return jnp.where(is_cast, logps["target_cast"], logps["target_attack"])
+
+
+def sample(
+    rng: jax.Array,
+    logits: Mapping[str, jnp.ndarray],
+    obs: Mapping[str, jnp.ndarray],
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+    """Sample every head; return (actions, joint conditional log-prob).
+
+    The action type is sampled first; the target head then samples under the
+    mask that type implies, so every emitted action is legal by construction.
+    """
+    logps = _head_logps(logits, obs)
+    k_type, k_mx, k_my, k_tgt, k_ab = jax.random.split(rng, 5)
+    a_type = jax.random.categorical(k_type, logps["action_type"], axis=-1)
+    target_logps = _select_target_logps(logps, a_type)
+    actions = {
+        "action_type": a_type,
+        "move_x": jax.random.categorical(k_mx, logps["move_x"], axis=-1),
+        "move_y": jax.random.categorical(k_my, logps["move_y"], axis=-1),
+        "target_unit": jax.random.categorical(k_tgt, target_logps, axis=-1),
+        "ability": jax.random.categorical(k_ab, logps["ability"], axis=-1),
+    }
+    return actions, _joint_logp(logps, actions)
+
+
+def log_prob(
+    logits: Mapping[str, jnp.ndarray],
+    obs: Mapping[str, jnp.ndarray],
+    actions: Mapping[str, jnp.ndarray],
+) -> jnp.ndarray:
+    """Joint conditional log-prob of stored ``actions`` under ``logits``."""
+    return _joint_logp(_head_logps(logits, obs), actions)
+
+
+def _take(logp: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    return jnp.take_along_axis(logp, idx[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+
+def _joint_logp(
+    logps: Mapping[str, jnp.ndarray], actions: Mapping[str, jnp.ndarray]
+) -> jnp.ndarray:
+    a_type = actions["action_type"]
+    move = (a_type == A_MOVE).astype(jnp.float32)
+    target = ((a_type == A_ATTACK) | (a_type == A_CAST)).astype(jnp.float32)
+    cast = (a_type == A_CAST).astype(jnp.float32)
+    target_logps = _select_target_logps(logps, a_type)
+    return (
+        _take(logps["action_type"], a_type)
+        + move * (_take(logps["move_x"], actions["move_x"])
+                  + _take(logps["move_y"], actions["move_y"]))
+        + target * _take(target_logps, actions["target_unit"])
+        + cast * _take(logps["ability"], actions["ability"])
+    )
+
+
+def entropy(
+    logits: Mapping[str, jnp.ndarray], obs: Mapping[str, jnp.ndarray]
+) -> jnp.ndarray:
+    """Exact entropy of the conditional factorization: masked per-head
+    entropies with sub-heads weighted by the probability their conditioning
+    action type is selected."""
+    logps = _head_logps(logits, obs)
+    p_type = jnp.exp(logps["action_type"])
+
+    def H(lp: jnp.ndarray) -> jnp.ndarray:
+        return -jnp.sum(jnp.exp(lp) * lp, axis=-1)
+
+    p_move = p_type[..., A_MOVE]
+    p_attack = p_type[..., A_ATTACK]
+    p_cast = p_type[..., A_CAST]
+    return (
+        H(logps["action_type"])
+        + p_move * (H(logps["move_x"]) + H(logps["move_y"]))
+        + p_attack * H(logps["target_attack"])
+        + p_cast * (H(logps["target_cast"]) + H(logps["ability"]))
+    )

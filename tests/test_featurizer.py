@@ -8,6 +8,7 @@ from hypothesis import given, settings, strategies as st
 from dotaclient_tpu.config import ActionSpec, ObsSpec
 from dotaclient_tpu.envs.lane_sim import LaneSim, TEAM_DIRE, TEAM_RADIANT
 from dotaclient_tpu.features import (
+    UNIT_FEATURES,
     decode_action,
     featurize,
     shaped_reward,
@@ -49,9 +50,7 @@ class TestShapes:
     def test_self_in_slot_zero(self):
         sim = make_sim()
         obs = featurize(sim.world_state(TEAM_RADIANT), 0, OBS, ACT)
-        is_self_col = list(__import__(
-            "dotaclient_tpu.features.featurizer", fromlist=["UNIT_FEATURES"]
-        ).UNIT_FEATURES).index("is_self")
+        is_self_col = list(UNIT_FEATURES).index("is_self")
         assert obs.units[0, is_self_col] == 1.0
         assert obs.unit_mask[0]
         # self is never a legal target
@@ -103,6 +102,22 @@ class TestMasks:
                 assert u.unit_type == pb.UNIT_LANE_CREEP
                 assert u.health < 0.5 * u.health_max
 
+    def test_cast_targets_are_in_range_enemies(self):
+        """CAST legality is stricter than ATTACK: enemies inside nuke range."""
+        sim = make_sim()
+        for _ in range(40):
+            ws = sim.world_state(TEAM_RADIANT)
+            obs = featurize(ws, 0, OBS, ACT)
+            by_handle = {u.handle: u for u in ws.units}
+            me = sim.hero_for_player(0)
+            for slot in np.flatnonzero(obs.mask_cast_target):
+                u = by_handle[int(obs.unit_handles[slot])]
+                assert u.team_id != TEAM_RADIANT
+                assert np.hypot(u.location.x - me.x, u.location.y - me.y) <= 600.0
+            if obs.mask_action_type[pb.ACTION_CAST]:
+                assert obs.mask_cast_target.any()
+            sim.step({})
+
     def test_dead_hero_can_only_noop(self):
         sim = make_sim()
         hero = sim.hero_for_player(0)
@@ -135,8 +150,11 @@ class TestCodec:
             "target_unit": 0,
             "ability": 0,
         }
-        if a_type in (pb.ACTION_ATTACK_UNIT, pb.ACTION_CAST):
+        if a_type == pb.ACTION_ATTACK_UNIT:
             legal_targets = list(np.flatnonzero(obs.mask_target_unit))
+            indices["target_unit"] = int(data.draw(st.sampled_from(legal_targets)))
+        elif a_type == pb.ACTION_CAST:
+            legal_targets = list(np.flatnonzero(obs.mask_cast_target))
             indices["target_unit"] = int(data.draw(st.sampled_from(legal_targets)))
         action = decode_action(indices, obs, player_id=0)
         assert action.player_id == 0

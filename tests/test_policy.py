@@ -1,0 +1,186 @@
+"""Policy model tests (SURVEY.md §4: mask correctness, LSTM state-carry
+equivalence scan-vs-steps, distribution consistency)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dotaclient_tpu.config import RunConfig
+from dotaclient_tpu.envs.lane_sim import LaneSim, TEAM_DIRE, TEAM_RADIANT
+from dotaclient_tpu.features import featurize, stack_observations
+from dotaclient_tpu.models import (
+    distributions as D,
+    dummy_obs_batch,
+    init_params,
+    make_policy,
+)
+from dotaclient_tpu.protos import dota_pb2 as pb
+
+CFG = RunConfig()
+# float32 end-to-end in tests so scan-vs-step comparisons are tight.
+MODEL = CFG.model.__class__(dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def policy_and_params():
+    policy = make_policy(MODEL, CFG.obs, CFG.actions)
+    params = init_params(policy, jax.random.PRNGKey(0), CFG.obs, CFG.actions)
+    # jit once per shape signature; shared across tests (module scope).
+    policy.jstep = jax.jit(lambda p, o, c: policy.apply(p, o, c, method="step"))
+    policy.jseq = jax.jit(lambda p, o, c: policy.apply(p, o, c, method="sequence"))
+    return policy, params
+
+
+def sim_obs_batch(batch: int, steps: int = 0):
+    """Batch of real (featurized) observations from perturbed sims."""
+    obs = []
+    for i in range(batch):
+        cfg = pb.GameConfig(
+            seed=i,
+            hero_picks=[
+                pb.HeroPick(team_id=TEAM_RADIANT, hero_id=1 + i % 3,
+                            control_mode=pb.CONTROL_AGENT),
+                pb.HeroPick(team_id=TEAM_DIRE, hero_id=1,
+                            control_mode=pb.CONTROL_SCRIPTED_EASY),
+            ],
+        )
+        sim = LaneSim(cfg)
+        for _ in range(steps + i):
+            sim.step({})
+        obs.append(featurize(sim.world_state(TEAM_RADIANT), 0, CFG.obs, CFG.actions))
+    return {k: jnp.asarray(v) for k, v in stack_observations(obs).items()}
+
+
+class TestForward:
+    def test_step_shapes_and_finiteness(self, policy_and_params):
+        policy, params = policy_and_params
+        obs = sim_obs_batch(4)
+        logits, value, carry = policy.jstep(params, obs, policy.initial_state(4))
+        for head, size in CFG.actions.head_sizes.items():
+            assert logits[head].shape == (4, size)
+            assert np.isfinite(np.asarray(logits[head])).all()
+        assert value.shape == (4,)
+        assert np.isfinite(np.asarray(value)).all()
+
+    def test_scan_equals_repeated_steps(self, policy_and_params):
+        """Sequence mode must reproduce T single steps exactly (the
+        truncated-BPTT contract the learner relies on, SURVEY.md §5.7)."""
+        policy, params = policy_and_params
+        B, T = 4, 5
+        rng = np.random.default_rng(0)
+        seq = dummy_obs_batch(B, CFG.obs, CFG.actions, time=T)
+        seq = dict(seq)
+        seq["units"] = jnp.asarray(
+            rng.normal(size=seq["units"].shape).astype(np.float32)
+        )
+        seq["unit_mask"] = jnp.asarray(np.ones(seq["unit_mask"].shape, bool))
+
+        carry = policy.initial_state(B)
+        logits_seq, value_seq, final_seq = policy.jseq(params, seq, carry)
+
+        carry_s = policy.initial_state(B)
+        step_values = []
+        step_type_logits = []
+        for t in range(T):
+            obs_t = {k: v[:, t] for k, v in seq.items()}
+            logits_t, value_t, carry_s = policy.jstep(params, obs_t, carry_s)
+            step_values.append(value_t)
+            step_type_logits.append(logits_t["action_type"])
+
+        np.testing.assert_allclose(
+            np.asarray(value_seq), np.stack([np.asarray(v) for v in step_values], 1),
+            rtol=2e-5, atol=2e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits_seq["action_type"]),
+            np.stack([np.asarray(l) for l in step_type_logits], 1),
+            rtol=2e-5, atol=2e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(final_seq[0]), np.asarray(carry_s[0]), rtol=2e-5, atol=2e-5
+        )
+
+    def test_padding_slots_do_not_affect_output(self, policy_and_params):
+        """Garbage in masked-out unit slots must be invisible to the model."""
+        policy, params = policy_and_params
+        obs = sim_obs_batch(4)
+        logits_a, value_a, _ = policy.jstep(params, obs, policy.initial_state(4))
+        units = np.asarray(obs["units"]).copy()
+        mask = np.asarray(obs["unit_mask"])
+        units[~mask] = 1e6  # poison the padding
+        obs_b = dict(obs)
+        obs_b["units"] = jnp.asarray(units)
+        logits_b, value_b, _ = policy.jstep(params, obs_b, policy.initial_state(4))
+        np.testing.assert_allclose(np.asarray(value_a), np.asarray(value_b), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(logits_a["action_type"]), np.asarray(logits_b["action_type"]), rtol=1e-5
+        )
+
+
+class TestDistributions:
+    def test_illegal_actions_never_sampled(self, policy_and_params):
+        policy, params = policy_and_params
+        obs = sim_obs_batch(4)
+        logits, _, _ = policy.jstep(params, obs, policy.initial_state(4))
+        mask_type = np.asarray(obs["mask_action_type"])
+        mask_target = np.asarray(obs["mask_target_unit"])
+        mask_cast = np.asarray(obs["mask_cast_target"])
+        sample_jit = jax.jit(lambda rng: D.sample(rng, logits, obs)[0])
+        for i in range(200):
+            actions = sample_jit(jax.random.PRNGKey(i))
+            a_type = np.asarray(actions["action_type"])
+            target = np.asarray(actions["target_unit"])
+            for b in range(4):
+                assert mask_type[b, a_type[b]], "illegal action type sampled"
+                if a_type[b] == D.A_ATTACK:
+                    assert mask_target[b, target[b]]
+                elif a_type[b] == D.A_CAST:
+                    assert mask_cast[b, target[b]]
+
+    def test_logprob_matches_sample(self, policy_and_params):
+        policy, params = policy_and_params
+        obs = sim_obs_batch(4)
+        logits, _, _ = policy.jstep(params, obs, policy.initial_state(4))
+        actions, logp = D.sample(jax.random.PRNGKey(7), logits, obs)
+        lp = D.log_prob(logits, obs, actions)
+        np.testing.assert_allclose(np.asarray(logp), np.asarray(lp), rtol=1e-5)
+        assert (np.asarray(logp) <= 0).all()
+
+    def test_irrelevant_heads_do_not_change_logprob(self, policy_and_params):
+        """NOOP's joint log-prob must ignore move/target/ability heads."""
+        policy, params = policy_and_params
+        obs = sim_obs_batch(4)
+        logits, _, _ = policy.jstep(params, obs, policy.initial_state(4))
+        actions = {
+            "action_type": jnp.zeros((4,), jnp.int32),  # NOOP
+            "move_x": jnp.zeros((4,), jnp.int32),
+            "move_y": jnp.zeros((4,), jnp.int32),
+            "target_unit": jnp.zeros((4,), jnp.int32),
+            "ability": jnp.zeros((4,), jnp.int32),
+        }
+        lp_a = D.log_prob(logits, obs, actions)
+        actions2 = dict(actions)
+        actions2["move_x"] = jnp.full((4,), 5, jnp.int32)
+        actions2["target_unit"] = jnp.full((4,), 3, jnp.int32)
+        lp_b = D.log_prob(logits, obs, actions2)
+        np.testing.assert_allclose(np.asarray(lp_a), np.asarray(lp_b), rtol=1e-6)
+
+    def test_entropy_nonnegative_and_finite(self, policy_and_params):
+        policy, params = policy_and_params
+        obs = sim_obs_batch(4)
+        logits, _, _ = policy.jstep(params, obs, policy.initial_state(4))
+        ent = np.asarray(D.entropy(logits, obs))
+        assert np.isfinite(ent).all()
+        assert (ent >= 0).all()
+
+    def test_fully_masked_head_stays_finite(self):
+        """A head with zero legal entries must not poison logp/entropy."""
+        logits = {h: jnp.zeros((2, n)) for h, n in CFG.actions.head_sizes.items()}
+        obs = dummy_obs_batch(2, CFG.obs, CFG.actions)
+        obs = dict(obs)
+        obs["mask_target_unit"] = jnp.zeros_like(obs["mask_target_unit"])  # none legal
+        obs["mask_cast_target"] = jnp.zeros_like(obs["mask_cast_target"])
+        actions, logp = D.sample(jax.random.PRNGKey(0), logits, obs)
+        assert np.isfinite(np.asarray(logp)).all()
+        assert np.isfinite(np.asarray(D.entropy(logits, obs))).all()
