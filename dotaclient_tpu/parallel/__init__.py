@@ -1,0 +1,5 @@
+"""Parallelism library: meshes, shardings, SP/TP/PP primitives."""
+
+from dotaclient_tpu.parallel.mesh import data_sharding, make_mesh, replicated
+
+__all__ = ["data_sharding", "make_mesh", "replicated"]

@@ -1,0 +1,52 @@
+"""Device-mesh construction and canonical shardings.
+
+The reference's only learner-side parallelism was (at most) NCCL data-parallel
+(SURVEY.md §2.3); here every distribution decision is a sharding annotation on
+a `jax.sharding.Mesh` and XLA emits the collectives over ICI/DCN
+(SURVEY.md §2.4, §5.8) — no hand-written communication.
+
+Axes:
+  * ``data``  — batch dimension; gradients psum over it.
+  * ``model`` — tensor-parallel axis for widened cores (unused at LSTM(128)
+    scale but first-class per SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dotaclient_tpu.config import MeshConfig
+
+
+def make_mesh(
+    config: MeshConfig, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """Build a (data, model) mesh over ``devices`` (default: all)."""
+    devices = list(devices if devices is not None else jax.devices())
+    model = max(1, config.model_parallel)
+    if len(devices) % model:
+        raise ValueError(
+            f"{len(devices)} devices not divisible by model_parallel={model}"
+        )
+    data = config.data_parallel
+    if data == -1:
+        data = len(devices) // model
+    if data * model != len(devices):
+        raise ValueError(
+            f"mesh {data}x{model} != {len(devices)} devices"
+        )
+    arr = np.asarray(devices).reshape(data, model)
+    return Mesh(arr, (config.data_axis, config.model_axis))
+
+
+def data_sharding(mesh: Mesh, config: MeshConfig) -> NamedSharding:
+    """Batch-sharded over the data axis (leading dimension)."""
+    return NamedSharding(mesh, P(config.data_axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,24 @@
+"""PPO learner: GAE, loss, pjit train step, training loop."""
+
+from dotaclient_tpu.train.gae import gae, gae_reference
+from dotaclient_tpu.train.ppo import (
+    Batch,
+    TrainState,
+    example_batch,
+    init_train_state,
+    make_optimizer,
+    make_train_step,
+    ppo_loss,
+)
+
+__all__ = [
+    "Batch",
+    "TrainState",
+    "example_batch",
+    "gae",
+    "gae_reference",
+    "init_train_state",
+    "make_optimizer",
+    "make_train_step",
+    "ppo_loss",
+]

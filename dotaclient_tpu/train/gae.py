@@ -1,0 +1,69 @@
+"""Generalized Advantage Estimation as a reverse ``lax.scan``.
+
+The reference computes GAE over the time axis inside its learner
+(SURVEY.md §3.2, BASELINE.json:5; reconstructed — the reference checkout was
+an empty mount). A sequential Python/torch loop there; here a single
+``lax.scan`` over time, batched over rollouts, fully inside jit so XLA fuses
+it with the surrounding loss computation (HEPPO-GAE, PAPERS.md, covers the
+hardware-friendly formulation space — a scan is already bandwidth-bound
+optimal at these sizes).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def gae(
+    rewards: jnp.ndarray,      # f32 [B, T]
+    values: jnp.ndarray,       # f32 [B, T+1] — includes bootstrap value
+    dones: jnp.ndarray,        # bool/f32 [B, T] — episode ended AT step t
+    gamma: float,
+    lam: float,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (advantages [B, T], returns [B, T]).
+
+    ``values[:, t]`` is V(s_t) under the *current* policy; ``values[:, T]`` is
+    the bootstrap for the state following the last transition. ``dones[:, t]``
+    cuts both the TD target and the accumulation, so chunks that straddle
+    episode boundaries (the truncated-BPTT regime of SURVEY.md §5.7) are
+    handled exactly.
+    """
+    not_done = 1.0 - dones.astype(jnp.float32)
+    deltas = rewards + gamma * not_done * values[:, 1:] - values[:, :-1]
+
+    def backward(carry, xs):
+        delta_t, nd_t = xs
+        carry = delta_t + gamma * lam * nd_t * carry
+        return carry, carry
+
+    # scan over time, reversed; batch axis rides along.
+    _, adv_rev = jax.lax.scan(
+        backward,
+        jnp.zeros_like(deltas[:, 0]),
+        (deltas.T, not_done.T),
+        reverse=True,
+    )
+    advantages = adv_rev.T
+    returns = advantages + values[:, :-1]
+    return advantages, returns
+
+
+def gae_reference(rewards, values, dones, gamma, lam):
+    """Plain NumPy reference implementation (test oracle, SURVEY.md §4)."""
+    import numpy as np
+
+    rewards, values, dones = map(np.asarray, (rewards, values, dones))
+    B, T = rewards.shape
+    adv = np.zeros((B, T), dtype=np.float64)
+    for b in range(B):
+        acc = 0.0
+        for t in reversed(range(T)):
+            nd = 1.0 - float(dones[b, t])
+            delta = rewards[b, t] + gamma * nd * values[b, t + 1] - values[b, t]
+            acc = delta + gamma * lam * nd * acc
+            adv[b, t] = acc
+    return adv.astype(np.float32), (adv + values[:, :-1]).astype(np.float32)

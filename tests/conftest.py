@@ -1,16 +1,24 @@
 """Test harness configuration.
 
-Forces JAX onto the host CPU platform with 8 virtual devices *before* jax is
-imported anywhere, so every sharding/collective test runs the same way the
-driver's multi-chip dry-run does (SURVEY.md §4 "Distributed-without-a-
-cluster") and the real TPU chip is never contended by the test suite.
+Forces JAX onto the host CPU platform with 8 virtual devices, so every
+sharding/collective test runs the same way the driver's multi-chip dry-run
+does (SURVEY.md §4 "Distributed-without-a-cluster") and the real TPU chip is
+never contended by the test suite.
+
+Note: this sandbox's sitecustomize pre-imports jax (axon PJRT registration)
+before any conftest runs, so setting JAX_PLATFORMS via os.environ here is too
+late. Backends initialize lazily, so `jax.config.update` still redirects, and
+XLA_FLAGS is read at first backend init — set both before any test touches a
+device.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+jax.config.update("jax_platforms", "cpu")
