@@ -1,0 +1,5 @@
+"""Sharded HBM-resident trajectory storage."""
+
+from dotaclient_tpu.buffer.trajectory_buffer import TrajectoryBuffer
+
+__all__ = ["TrajectoryBuffer"]

@@ -1,0 +1,153 @@
+"""Sharded HBM-resident trajectory ring buffer.
+
+The reference's learner blocked on RabbitMQ and stacked rollouts in host
+memory each step (SURVEY.md §3.2). The TPU-native design keeps the trajectory
+store *on device*, batch-sharded over the mesh's data axis — the north-star
+architecture of BASELINE.json:5 — so a train step consumes its batch without
+any host↔device copy beyond the initial staged ingest (SURVEY.md §7 step 5).
+
+Shape contract: one slot holds one rollout chunk laid out exactly like a
+``train.ppo.Batch`` row (obs ``[T+1, ...]``, actions/rewards/... ``[T]``,
+``carry0`` ``([H],[H])``); a consumed batch of B slots IS a train batch.
+
+Concurrency: host-side bookkeeping (cursor, versions) is plain Python driven
+by the single learner thread; actors never touch the buffer — they hand
+protos to the transport, and the learner's ingest drains it (same
+single-writer discipline the reference gets from its one blocking consumer).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dotaclient_tpu.config import RunConfig
+from dotaclient_tpu.train.ppo import example_batch
+
+
+class TrajectoryBuffer:
+    """FIFO ring of rollout chunks in device memory.
+
+    PPO is (nearly) on-policy: rollouts are consumed oldest-first, exactly
+    once, with version-based staleness filtering at ingest (SURVEY.md §2.3
+    "Async off-policy DP").
+    """
+
+    def __init__(self, config: RunConfig, mesh: Mesh) -> None:
+        self.config = config
+        self.mesh = mesh
+        n_data = mesh.shape[config.mesh.data_axis]
+        cap = config.buffer.capacity_rollouts
+        if cap % n_data:
+            raise ValueError(
+                f"buffer capacity {cap} not divisible by data-parallel size {n_data}"
+            )
+        if config.ppo.batch_rollouts % n_data:
+            raise ValueError(
+                f"batch_rollouts {config.ppo.batch_rollouts} not divisible by "
+                f"data-parallel size {n_data} (batches are data-sharded)"
+            )
+        self.capacity = cap
+        self._sharding = NamedSharding(mesh, P(config.mesh.data_axis))
+        template = example_batch(config, batch=cap)
+        self._store = jax.tree.map(
+            lambda x: jax.device_put(x, self._sharding), template
+        )
+        # Host-side ring bookkeeping.
+        self._write = 0            # next slot to write
+        self._read = 0             # next slot to consume
+        self._size = 0             # filled, unconsumed slots
+        self._versions = np.full((cap,), -1, dtype=np.int64)
+        self.dropped_stale = 0
+        self.ingested = 0
+
+        self._scatter = jax.jit(
+            lambda store, rows, idx: jax.tree.map(
+                lambda s, r: s.at[idx].set(r), store, rows
+            ),
+            donate_argnums=(0,),
+            out_shardings=jax.tree.map(lambda _: self._sharding, template),
+        )
+        self._gather = jax.jit(
+            lambda store, idx: jax.tree.map(lambda s: s[idx], store),
+            out_shardings=jax.tree.map(lambda _: self._sharding, template),
+        )
+
+    # -- properties --------------------------------------------------------
+
+    @property
+    def size(self) -> int:
+        return self._size
+
+    @property
+    def ready(self) -> bool:
+        return self._size >= max(
+            self.config.buffer.min_fill, self.config.ppo.batch_rollouts
+        )
+
+    # -- ingest ------------------------------------------------------------
+
+    def add(
+        self,
+        rollouts: List[Tuple[Dict[str, Any], Any]],
+        current_version: int,
+    ) -> int:
+        """Ingest decoded rollouts ``(meta, arrays)``; returns number kept.
+
+        Stale rollouts (older than ``ppo.max_staleness`` versions) are
+        dropped here — the reference's version-tag discipline (SURVEY.md
+        §3.4) applied at the buffer door.
+        """
+        fresh = []
+        for meta, arrays in rollouts:
+            if current_version - meta["model_version"] > self.config.ppo.max_staleness:
+                self.dropped_stale += 1
+                continue
+            fresh.append((meta, arrays))
+        if not fresh:
+            return 0
+
+        rows = jax.tree.map(
+            lambda *xs: np.stack(xs), *[arrays for _, arrays in fresh]
+        )
+        idx = np.array(
+            [(self._write + i) % self.capacity for i in range(len(fresh))],
+            dtype=np.int32,
+        )
+        self._store = self._scatter(self._store, rows, jnp.asarray(idx))
+        for j, (meta, _) in zip(idx, fresh):
+            self._versions[j] = meta["model_version"]
+        self._write = int((self._write + len(fresh)) % self.capacity)
+        overflow = max(0, self._size + len(fresh) - self.capacity)
+        if overflow:  # ring overwrote oldest unconsumed slots
+            self._read = int((self._read + overflow) % self.capacity)
+        self._size = min(self._size + len(fresh), self.capacity)
+        self.ingested += len(fresh)
+        return len(fresh)
+
+    # -- consume -----------------------------------------------------------
+
+    def take(self, batch_size: Optional[int] = None) -> Optional[Dict[str, Any]]:
+        """Consume the oldest ``batch_size`` rollouts as a train batch
+        (device arrays, batch-sharded). Returns None if underfilled."""
+        b = batch_size or self.config.ppo.batch_rollouts
+        if self._size < b:
+            return None
+        idx = np.array(
+            [(self._read + i) % self.capacity for i in range(b)], dtype=np.int32
+        )
+        batch = self._gather(self._store, jnp.asarray(idx))
+        self._read = int((self._read + b) % self.capacity)
+        self._size -= b
+        return batch
+
+    def metrics(self) -> Dict[str, float]:
+        return {
+            "buffer_size": float(self._size),
+            "buffer_ingested": float(self.ingested),
+            "buffer_dropped_stale": float(self.dropped_stale),
+        }

@@ -440,6 +440,7 @@ class LaneSim:
                     cooldown_remaining=unit.ability_cooldown,
                     level=unit.level,
                     castable=(unit.ability_cooldown <= 0.0 and unit.mana >= NUKE_MANA),
+                    cast_range=NUKE_RANGE,
                 )
         for hero in self.heroes:
             ws.players.add(

@@ -127,7 +127,13 @@ def featurize(
     )
     ordered = ([me] if me is not None else []) + others
 
-    nuke_range = 600.0  # parity with lane_sim.NUKE_RANGE
+    # Cast range comes from the worldstate itself (Ability.cast_range of the
+    # controlled hero's castable ability), not from baked game knowledge.
+    cast_range = 0.0
+    if me is not None:
+        for a in me.abilities:
+            if a.castable and a.cast_range > 0.0:
+                cast_range = max(cast_range, a.cast_range)
     any_attackable = False
     any_nukable = False
     self_castable = False
@@ -180,7 +186,7 @@ def featurize(
         if me_alive and attack_ok:
             mask_target[slot] = True
             any_attackable = True
-        if me_alive and not is_ally and dist <= nuke_range:
+        if me_alive and not is_ally and cast_range > 0.0 and dist <= cast_range:
             mask_cast[slot] = True
             any_nukable = True
 

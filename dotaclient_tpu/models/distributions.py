@@ -57,13 +57,13 @@ def _head_logps(
 ) -> Dict[str, jnp.ndarray]:
     """Masked, normalized log-probs per head. The target head appears twice,
     once per conditioning action type."""
-    ones = jnp.ones_like(logits["move_x"], dtype=bool)
     return {
         "action_type": masked_log_softmax(
             logits["action_type"], obs["mask_action_type"]
         ),
-        "move_x": masked_log_softmax(logits["move_x"], ones),
-        "move_y": masked_log_softmax(logits["move_y"], ones),
+        # Move heads are always fully legal — no mask path needed.
+        "move_x": jax.nn.log_softmax(logits["move_x"], axis=-1),
+        "move_y": jax.nn.log_softmax(logits["move_y"], axis=-1),
         "target_attack": masked_log_softmax(
             logits["target_unit"], obs["mask_target_unit"]
         ),

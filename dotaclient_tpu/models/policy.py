@@ -178,11 +178,10 @@ def make_policy(model: ModelConfig, obs_spec: ObsSpec, action_spec: ActionSpec) 
     return Policy(model=model, obs_spec=obs_spec, action_spec=action_spec)
 
 
-def init_params(
-    policy: Policy, rng: jax.Array, obs_spec: ObsSpec, action_spec: ActionSpec
-):
-    """Initialize parameters from a dummy batch-1 observation."""
-    dummy = dummy_obs_batch(1, obs_spec, action_spec)
+def init_params(policy: Policy, rng: jax.Array):
+    """Initialize parameters from a dummy batch-1 observation (shapes come
+    from the policy's own specs)."""
+    dummy = dummy_obs_batch(1, policy.obs_spec, policy.action_spec)
     carry = policy.initial_state(1)
     return policy.init(rng, dummy, carry)
 

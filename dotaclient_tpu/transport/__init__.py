@@ -1,0 +1,31 @@
+"""Experience/weight transport: wire codec + pluggable queues."""
+
+from dotaclient_tpu.transport.queues import (
+    AmqpTransport,
+    InProcTransport,
+    Transport,
+)
+from dotaclient_tpu.transport.serialize import (
+    decode_rollout,
+    decode_weights,
+    encode_rollout,
+    encode_weights,
+    flatten_tree,
+    proto_to_tensor,
+    tensor_to_proto,
+    unflatten_tree,
+)
+
+__all__ = [
+    "AmqpTransport",
+    "InProcTransport",
+    "Transport",
+    "decode_rollout",
+    "decode_weights",
+    "encode_rollout",
+    "encode_weights",
+    "flatten_tree",
+    "proto_to_tensor",
+    "tensor_to_proto",
+    "unflatten_tree",
+]

@@ -1,0 +1,170 @@
+"""Experience/weight transport behind one pluggable interface.
+
+The reference used a RabbitMQ broker: an experience *queue* (actor→learner)
+and a model fanout *exchange* (learner→actors), via pika (SURVEY.md §1
+"Transport / messaging", §2.4). This sandbox has no broker and no network
+(SURVEY.md §7), so the same API is served by an in-process implementation;
+``AmqpTransport`` keeps the cluster path compilable and import-gated.
+
+Semantics preserved from the reference design:
+  * experience is a work queue — each rollout is consumed by exactly one
+    learner;
+  * weights are a fanout with replacement — actors only ever want the
+    *latest* version (stale intermediate weight messages are worthless).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import List, Optional, Protocol
+
+from dotaclient_tpu.protos import dota_pb2 as pb
+
+
+class Transport(Protocol):
+    """Both directions of the actor↔learner channel."""
+
+    def publish_rollout(self, rollout: pb.Rollout) -> None: ...
+    def consume_rollouts(
+        self, max_count: int, timeout: Optional[float] = None
+    ) -> List[pb.Rollout]: ...
+    def publish_weights(self, weights: pb.ModelWeights) -> None: ...
+    def latest_weights(self) -> Optional[pb.ModelWeights]: ...
+
+
+class InProcTransport:
+    """Thread-safe in-process transport (dev/test/single-host production).
+
+    The actor multiplexer and learner run in one process on the TPU host
+    (SURVEY.md §7 "Minimum end-to-end slice"); this is the zero-copy path —
+    protos are passed by reference, never serialized to bytes.
+    """
+
+    def __init__(self, max_rollouts: int = 4096) -> None:
+        self._rollouts: "queue.Queue[pb.Rollout]" = queue.Queue(max_rollouts)
+        self._weights_lock = threading.Lock()
+        self._weights: Optional[pb.ModelWeights] = None
+        self.dropped = 0
+
+    def publish_rollout(self, rollout: pb.Rollout) -> None:
+        try:
+            self._rollouts.put_nowait(rollout)
+        except queue.Full:
+            # Actors must never block on a slow learner (the reference relies
+            # on RMQ buffering; here backpressure = drop-oldest).
+            try:
+                self._rollouts.get_nowait()
+                self.dropped += 1
+            except queue.Empty:
+                pass
+            self._rollouts.put_nowait(rollout)
+
+    def consume_rollouts(
+        self, max_count: int, timeout: Optional[float] = None
+    ) -> List[pb.Rollout]:
+        out: List[pb.Rollout] = []
+        try:
+            out.append(self._rollouts.get(timeout=timeout))
+        except queue.Empty:
+            return out
+        while len(out) < max_count:
+            try:
+                out.append(self._rollouts.get_nowait())
+            except queue.Empty:
+                break
+        return out
+
+    def publish_weights(self, weights: pb.ModelWeights) -> None:
+        with self._weights_lock:
+            self._weights = weights
+
+    def latest_weights(self) -> Optional[pb.ModelWeights]:
+        with self._weights_lock:
+            return self._weights
+
+    @property
+    def pending_rollouts(self) -> int:
+        return self._rollouts.qsize()
+
+
+class AmqpTransport:
+    """RabbitMQ-backed transport with the reference's topology: a durable
+    experience queue and a fanout weights exchange.
+
+    Import-gated: pika (and a broker) exist on a cluster, not in this sandbox
+    (SURVEY.md §7). The class compiles here; connecting raises a clear error
+    without pika.
+    """
+
+    EXPERIENCE_QUEUE = "experience"
+    WEIGHTS_EXCHANGE = "weights"
+
+    def __init__(self, host: str, port: int = 5672) -> None:
+        try:
+            import pika  # type: ignore[import-not-found]
+        except ImportError as e:  # pragma: no cover - sandbox has no pika
+            raise RuntimeError(
+                "AmqpTransport requires pika (and a reachable RabbitMQ "
+                "broker); use InProcTransport in broker-less environments"
+            ) from e
+        self._pika = pika
+        self._params = pika.ConnectionParameters(host=host, port=port)
+        self._conn = pika.BlockingConnection(self._params)
+        self._ch = self._conn.channel()
+        self._ch.queue_declare(queue=self.EXPERIENCE_QUEUE, durable=True)
+        self._ch.exchange_declare(
+            exchange=self.WEIGHTS_EXCHANGE, exchange_type="fanout"
+        )
+        res = self._ch.queue_declare(queue="", exclusive=True)
+        self._weights_queue = res.method.queue
+        self._ch.queue_bind(
+            exchange=self.WEIGHTS_EXCHANGE, queue=self._weights_queue
+        )
+
+    def publish_rollout(self, rollout: pb.Rollout) -> None:  # pragma: no cover
+        self._ch.basic_publish(
+            exchange="",
+            routing_key=self.EXPERIENCE_QUEUE,
+            body=rollout.SerializeToString(),
+        )
+
+    def consume_rollouts(
+        self, max_count: int, timeout: Optional[float] = None
+    ) -> List[pb.Rollout]:  # pragma: no cover
+        out: List[pb.Rollout] = []
+        for method, _props, body in self._ch.consume(
+            self.EXPERIENCE_QUEUE, inactivity_timeout=timeout
+        ):
+            if body is None:
+                break
+            r = pb.Rollout()
+            r.ParseFromString(body)
+            out.append(r)
+            self._ch.basic_ack(method.delivery_tag)
+            if len(out) >= max_count:
+                break
+        self._ch.cancel()
+        return out
+
+    def publish_weights(self, weights: pb.ModelWeights) -> None:  # pragma: no cover
+        self._ch.basic_publish(
+            exchange=self.WEIGHTS_EXCHANGE,
+            routing_key="",
+            body=weights.SerializeToString(),
+        )
+
+    def latest_weights(self) -> Optional[pb.ModelWeights]:  # pragma: no cover
+        latest: Optional[bytes] = None
+        while True:
+            method, _props, body = self._ch.basic_get(
+                self._weights_queue, auto_ack=True
+            )
+            if body is None:
+                break
+            latest = body
+        if latest is None:
+            return None
+        msg = pb.ModelWeights()
+        msg.ParseFromString(latest)
+        return msg

@@ -1,0 +1,134 @@
+"""Rollout wire-format codec: host pytrees ↔ ``Rollout`` protos.
+
+The reference shipped experience as protobuf payloads over RabbitMQ but left
+the payload schema implicit (SURVEY.md §2.1 "Transport", §7 step 1); here it
+is first-party: a flat ``name → TensorProto`` map whose names are the
+slash-joined paths of the training-batch pytree (``obs/units``,
+``actions/move_x``, ``carry0/h``, ...). The same codec serves the learner→
+actor weights direction (``ModelWeights``).
+
+Decode is the hot ingestion path; a C++ fast-path decoder with the same wire
+format backs ``decode_rollout`` when built (SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Tuple
+
+import numpy as np
+
+try:  # bfloat16 arrays cross the wire when actors run bf16 inference
+    import ml_dtypes
+
+    _BFLOAT16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    _BFLOAT16 = None
+
+from dotaclient_tpu.protos import dota_pb2 as pb
+
+
+def _np_dtype(name: str) -> np.dtype:
+    if name == "bfloat16":
+        if _BFLOAT16 is None:
+            raise ValueError("bfloat16 payload but ml_dtypes unavailable")
+        return _BFLOAT16
+    return np.dtype(name)
+
+
+def _dtype_name(dtype: np.dtype) -> str:
+    if _BFLOAT16 is not None and dtype == _BFLOAT16:
+        return "bfloat16"
+    return dtype.name
+
+
+def flatten_tree(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
+    """Flatten a nested dict/tuple pytree of arrays to slash-joined names."""
+    out: Dict[str, np.ndarray] = {}
+    if isinstance(tree, Mapping):
+        items = tree.items()
+    elif isinstance(tree, (tuple, list)):
+        items = ((str(i), v) for i, v in enumerate(tree))
+    else:
+        out[prefix.rstrip("/")] = np.asarray(tree)
+        return out
+    for k, v in items:
+        out.update(flatten_tree(v, f"{prefix}{k}/"))
+    return out
+
+
+def unflatten_tree(flat: Mapping[str, np.ndarray]) -> Dict[str, Any]:
+    """Inverse of :func:`flatten_tree` (all-numeric levels become tuples)."""
+    nested: Dict[str, Any] = {}
+    for name, value in flat.items():
+        parts = name.split("/")
+        node = nested
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+
+    def fix(node):
+        if not isinstance(node, dict):
+            return node
+        if node and all(k.isdigit() for k in node):
+            return tuple(fix(node[str(i)]) for i in range(len(node)))
+        return {k: fix(v) for k, v in node.items()}
+
+    return fix(nested)
+
+
+def tensor_to_proto(arr: np.ndarray) -> pb.TensorProto:
+    arr = np.ascontiguousarray(arr)
+    return pb.TensorProto(
+        shape=list(arr.shape), dtype=_dtype_name(arr.dtype), data=arr.tobytes()
+    )
+
+
+def proto_to_tensor(t: pb.TensorProto) -> np.ndarray:
+    arr = np.frombuffer(t.data, dtype=_np_dtype(t.dtype))
+    return arr.reshape(tuple(t.shape)).copy()
+
+
+def encode_rollout(
+    arrays: Any,
+    model_version: int,
+    env_id: int,
+    rollout_id: int,
+    length: int,
+    total_reward: float,
+) -> pb.Rollout:
+    """Serialize one rollout's pytree of host arrays."""
+    r = pb.Rollout(
+        model_version=model_version,
+        env_id=env_id,
+        rollout_id=rollout_id,
+        length=length,
+        total_reward=total_reward,
+    )
+    for name, arr in flatten_tree(arrays).items():
+        r.arrays[name].CopyFrom(tensor_to_proto(arr))
+    return r
+
+
+def decode_rollout(r: pb.Rollout) -> Tuple[Dict[str, Any], Any]:
+    """Deserialize → (meta dict, pytree of arrays)."""
+    meta = {
+        "model_version": r.model_version,
+        "env_id": r.env_id,
+        "rollout_id": r.rollout_id,
+        "length": r.length,
+        "total_reward": r.total_reward,
+    }
+    flat = {name: proto_to_tensor(t) for name, t in r.arrays.items()}
+    return meta, unflatten_tree(flat)
+
+
+def encode_weights(params: Any, version: int) -> pb.ModelWeights:
+    msg = pb.ModelWeights(version=version)
+    for name, arr in flatten_tree(params).items():
+        msg.params[name].CopyFrom(tensor_to_proto(np.asarray(arr)))
+    return msg
+
+
+def decode_weights(msg: pb.ModelWeights) -> Tuple[int, Any]:
+    flat = {name: proto_to_tensor(t) for name, t in msg.params.items()}
+    return msg.version, unflatten_tree(flat)

@@ -6,7 +6,7 @@ import pytest
 from hypothesis import given, settings, strategies as st
 
 from dotaclient_tpu.config import ActionSpec, ObsSpec
-from dotaclient_tpu.envs.lane_sim import LaneSim, TEAM_DIRE, TEAM_RADIANT
+from dotaclient_tpu.envs.lane_sim import LaneSim, NUKE_RANGE, TEAM_DIRE, TEAM_RADIANT
 from dotaclient_tpu.features import (
     UNIT_FEATURES,
     decode_action,
@@ -113,7 +113,7 @@ class TestMasks:
             for slot in np.flatnonzero(obs.mask_cast_target):
                 u = by_handle[int(obs.unit_handles[slot])]
                 assert u.team_id != TEAM_RADIANT
-                assert np.hypot(u.location.x - me.x, u.location.y - me.y) <= 600.0
+                assert np.hypot(u.location.x - me.x, u.location.y - me.y) <= NUKE_RANGE
             if obs.mask_action_type[pb.ACTION_CAST]:
                 assert obs.mask_cast_target.any()
             sim.step({})

@@ -25,7 +25,7 @@ MODEL = CFG.model.__class__(dtype="float32")
 @pytest.fixture(scope="module")
 def policy_and_params():
     policy = make_policy(MODEL, CFG.obs, CFG.actions)
-    params = init_params(policy, jax.random.PRNGKey(0), CFG.obs, CFG.actions)
+    params = init_params(policy, jax.random.PRNGKey(0))
     # jit once per shape signature; shared across tests (module scope).
     policy.jstep = jax.jit(lambda p, o, c: policy.apply(p, o, c, method="step"))
     policy.jseq = jax.jit(lambda p, o, c: policy.apply(p, o, c, method="sequence"))
