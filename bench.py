@@ -1,0 +1,102 @@
+"""Headline benchmark: PPO optimizer frames/sec (BASELINE.json "metric").
+
+Measures the learner hot path — the single donated pjit train step (sequence
+forward + GAE + loss + grad + Adam) — on benchmark config 1's shapes
+(1v1-mid, LSTM(128), batch_rollouts × rollout_len; BASELINE.json "configs").
+The batch is device-resident (the production path keeps trajectories in the
+sharded HBM buffer), so this isolates optimizer throughput exactly as the
+reference metric does.
+
+The reference publishes no number (BASELINE.json "published": {}); the first
+run on a given machine records its measurement to ``bench_anchor.json`` and
+later runs report ``vs_baseline`` against that anchor, so the driver sees the
+cross-round trajectory.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import numpy as np
+
+ANCHOR_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_anchor.json")
+
+
+def main() -> None:
+    from dotaclient_tpu.config import default_config
+    from dotaclient_tpu.models import init_params, make_policy
+    from dotaclient_tpu.parallel import make_mesh
+    from dotaclient_tpu.train import example_batch, init_train_state, make_train_step
+
+    config = default_config()
+    mesh = make_mesh(config.mesh)
+    policy = make_policy(config.model, config.obs, config.actions)
+    params = init_params(policy, jax.random.PRNGKey(0))
+    state = init_train_state(params, config.ppo)
+    step = make_train_step(policy, config, mesh)
+
+    B, T = config.ppo.batch_rollouts, config.ppo.rollout_len
+    rng = np.random.default_rng(0)
+    batch = example_batch(config, batch=B)
+    # Non-degenerate data so the loss/gradients are representative.
+    batch["obs"] = dict(batch["obs"])
+    batch["obs"]["units"] = jax.numpy.asarray(
+        rng.normal(size=batch["obs"]["units"].shape).astype(np.float32)
+    )
+    batch["rewards"] = jax.numpy.asarray(
+        rng.normal(size=(B, T)).astype(np.float32) * 0.1
+    )
+    batch["behavior_logp"] = jax.numpy.asarray(
+        -np.abs(rng.normal(size=(B, T))).astype(np.float32)
+    )
+
+    # Warmup (compile) + steady-state timing.
+    for _ in range(3):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    n_steps = 50
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    elapsed = time.perf_counter() - t0
+    frames_per_sec = B * T * n_steps / elapsed
+
+    anchor = None
+    if os.path.exists(ANCHOR_PATH):
+        try:
+            with open(ANCHOR_PATH) as f:
+                anchor = json.load(f).get("frames_per_sec")
+        except (json.JSONDecodeError, OSError):
+            anchor = None
+    if anchor is None:
+        with open(ANCHOR_PATH, "w") as f:
+            json.dump(
+                {
+                    "frames_per_sec": frames_per_sec,
+                    "device": jax.devices()[0].device_kind,
+                    "recorded_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+                },
+                f,
+            )
+        anchor = frames_per_sec
+
+    print(
+        json.dumps(
+            {
+                "metric": "ppo_optimizer_frames_per_sec",
+                "value": round(frames_per_sec, 1),
+                "unit": "frames/sec",
+                "vs_baseline": round(frames_per_sec / anchor, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,5 @@
+"""Batched actor runtime."""
+
+from dotaclient_tpu.actor.runtime import ActorPool, build_game_config
+
+__all__ = ["ActorPool", "build_game_config"]

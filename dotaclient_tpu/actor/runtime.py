@@ -1,0 +1,375 @@
+"""Batched actor runtime: many envs, one jitted policy step.
+
+The reference runs one asyncio ``agent.py`` process per game doing batch-1
+CPU inference in its hot loop (SURVEY.md §3.1 — "the #1 throughput sin the
+TPU rebuild fixes"). Here a single multiplexer owns N environment *lanes*
+(an env × agent-controlled player pair), featurizes all of them, and advances
+every lane with ONE batched, jitted ``policy.step`` on the device
+(SURVEY.md §7 step 6; Podracer/SEED-style batched inference, PAPERS.md).
+
+Rollout-chunk semantics (parity with the reference's truncated-BPTT
+transport, SURVEY.md §5.7, and the learner's ``train.ppo.Batch`` contract):
+
+* a chunk is at most ``ppo.rollout_len`` steps and never spans episodes —
+  on episode end it is padded (``valid=0``) and shipped early;
+* the chunk carries its initial LSTM state (``carry0``) and ``T+1``
+  observations (the trailing one is the learner's bootstrap state);
+* each chunk is tagged with the model version that produced it.
+
+Weight refresh follows the reference's hot-swap discipline (SURVEY.md §3.4):
+the pool polls the transport for the latest published weights between steps
+and bumps its version tag.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dotaclient_tpu.config import RunConfig
+from dotaclient_tpu.envs.env_api import LocalDotaEnv
+from dotaclient_tpu.envs import lane_sim
+from dotaclient_tpu.features import (
+    Observation,
+    decode_action,
+    featurize,
+    observation_to_dict,
+    shaped_reward,
+    stack_observations,
+)
+from dotaclient_tpu.models import distributions as D
+from dotaclient_tpu.models.policy import Policy
+from dotaclient_tpu.protos import dota_pb2 as pb
+from dotaclient_tpu.transport import Transport, decode_weights, encode_rollout
+
+
+def build_game_config(config: RunConfig, seed: int) -> pb.GameConfig:
+    """EnvConfig → GameConfig proto (the env-boundary config the reference
+    kept as a proto too, SURVEY.md §5.6)."""
+    env = config.env
+    picks = []
+    pool = env.hero_pool or (1,)
+    rng = np.random.default_rng(seed)
+    opp_mode = {
+        "scripted_easy": pb.CONTROL_SCRIPTED_EASY,
+        "scripted_hard": pb.CONTROL_SCRIPTED_HARD,
+        "selfplay": pb.CONTROL_AGENT,
+        "league": pb.CONTROL_AGENT,
+    }[env.opponent]
+    for team, mode in (
+        (lane_sim.TEAM_RADIANT, pb.CONTROL_AGENT),
+        (lane_sim.TEAM_DIRE, opp_mode),
+    ):
+        for _ in range(env.team_size):
+            picks.append(
+                pb.HeroPick(
+                    team_id=team,
+                    hero_id=int(rng.choice(pool)),
+                    control_mode=mode,
+                )
+            )
+    return pb.GameConfig(
+        ticks_per_observation=env.ticks_per_observation,
+        seed=seed,
+        max_dota_time=env.max_dota_time,
+        hero_picks=picks,
+    )
+
+
+@dataclasses.dataclass
+class _Lane:
+    """One agent-controlled player inside one environment."""
+
+    env_idx: int
+    player_id: int
+    team_id: int
+    prev_ws: pb.WorldState = None  # type: ignore[assignment]
+    obs: Observation = None        # type: ignore[assignment]
+    # chunk accumulators
+    obs_seq: List[Observation] = dataclasses.field(default_factory=list)
+    actions: List[Dict[str, int]] = dataclasses.field(default_factory=list)
+    logps: List[float] = dataclasses.field(default_factory=list)
+    rewards: List[float] = dataclasses.field(default_factory=list)
+    dones: List[float] = dataclasses.field(default_factory=list)
+    carry0: Tuple[np.ndarray, np.ndarray] = None  # type: ignore[assignment]
+    # model version at chunk start — a mid-chunk weight refresh must not
+    # re-label earlier steps as fresh, so the chunk ships with the OLDEST
+    # version that contributed to it (conservative for staleness filtering).
+    version0: int = 0
+    # episode stats
+    episode_reward: float = 0.0
+
+
+class ActorPool:
+    """N-lane batched actor.
+
+    ``opponent="selfplay"`` makes every hero an agent lane sharing the same
+    params (the reference's self-play configs, BASELINE.json:8); scripted
+    opponents are driven inside the env. League opponents (frozen past
+    params) plug in through ``league.opponents`` (separate pools).
+    """
+
+    def __init__(
+        self,
+        config: RunConfig,
+        policy: Policy,
+        params: Any,
+        transport: Optional[Transport] = None,
+        env_factory: Callable[[], LocalDotaEnv] = LocalDotaEnv,
+        seed: int = 0,
+        version: int = 0,
+        rollout_sink: Optional[Callable[[pb.Rollout], None]] = None,
+    ) -> None:
+        self.config = config
+        self.policy = policy
+        self.params = params
+        self.version = version
+        self.transport = transport
+        self.rollout_sink = rollout_sink
+        self._rng = jax.random.PRNGKey(seed)
+        self._seed = seed
+        self._next_rollout_id = 0
+        self._next_game_seed = seed * 100_003
+
+        self.envs: List[LocalDotaEnv] = [
+            env_factory() for _ in range(config.env.n_envs)
+        ]
+        self.lanes: List[_Lane] = []
+        for i, env in enumerate(self.envs):
+            self._reset_env(i, env)
+        n = len(self.lanes)
+        self._carry = (
+            np.zeros((n, config.model.hidden_dim), np.float32),
+            np.zeros((n, config.model.hidden_dim), np.float32),
+        )
+        for lane in self.lanes:
+            self._begin_chunk(lane)
+
+        self._step_fn = jax.jit(self._device_step)
+        # throughput counters
+        self.env_steps = 0
+        self.rollouts_shipped = 0
+        self.episodes_done = 0
+        self.episode_rewards: List[float] = []
+        self.wins = 0
+
+    # -- env / lane lifecycle ---------------------------------------------
+
+    def _reset_env(self, env_idx: int, env: LocalDotaEnv) -> None:
+        game_cfg = build_game_config(self.config, self._next_game_seed)
+        self._next_game_seed += 1
+        init = env.reset(game_cfg)
+        assert init.status == pb.STATUS_OK
+        # Lanes for this env: every agent-controlled hero.
+        existing = [l for l in self.lanes if l.env_idx == env_idx]
+        ws_by_team = {ws.team_id: ws for ws in init.world_states}
+        agent_players = self._agent_players(game_cfg)
+        if existing:
+            assert len(existing) == len(agent_players)
+            for lane, (player_id, team_id) in zip(existing, agent_players):
+                lane.player_id = player_id
+                lane.team_id = team_id
+                ws = ws_by_team[team_id]
+                lane.prev_ws = ws
+                lane.obs = self._featurize(ws, player_id)
+                lane.episode_reward = 0.0
+        else:
+            for player_id, team_id in agent_players:
+                ws = ws_by_team[team_id]
+                lane = _Lane(env_idx=env_idx, player_id=player_id, team_id=team_id)
+                lane.prev_ws = ws
+                lane.obs = self._featurize(ws, player_id)
+                self.lanes.append(lane)
+
+    @staticmethod
+    def _agent_players(game_cfg: pb.GameConfig) -> List[Tuple[int, int]]:
+        return [
+            (pid, pick.team_id)
+            for pid, pick in enumerate(game_cfg.hero_picks)
+            if pick.control_mode == pb.CONTROL_AGENT
+        ]
+
+    def _featurize(self, ws: pb.WorldState, player_id: int) -> Observation:
+        return featurize(ws, player_id, self.config.obs, self.config.actions)
+
+    def _begin_chunk(self, lane: _Lane) -> None:
+        i = self.lanes.index(lane)
+        lane.obs_seq = []
+        lane.actions = []
+        lane.logps = []
+        lane.rewards = []
+        lane.dones = []
+        lane.carry0 = (self._carry[0][i].copy(), self._carry[1][i].copy())
+        lane.version0 = self.version
+
+    # -- device step -------------------------------------------------------
+
+    def _device_step(self, params, obs_batch, carry, rng):
+        logits, value, new_carry = self.policy.apply(
+            params, obs_batch, carry, method="step"
+        )
+        actions, logp = D.sample(rng, logits, obs_batch)
+        return actions, logp, value, new_carry
+
+    # -- public API --------------------------------------------------------
+
+    def refresh_weights(self) -> bool:
+        """Hot-swap to the latest published weights, if any (SURVEY.md §3.4)."""
+        if self.transport is None:
+            return False
+        msg = self.transport.latest_weights()
+        if msg is None or msg.version == self.version:
+            return False
+        version, tree = decode_weights(msg)
+        self.params = jax.tree.map(jnp.asarray, tree)
+        self.version = version
+        return True
+
+    def set_params(self, params: Any, version: int) -> None:
+        """Direct replicated-params refresh (in-process learner path — the
+        'actors read replicated JAX params' mode of BASELINE.json:5)."""
+        self.params = params
+        self.version = version
+
+    def step(self) -> None:
+        """Advance every lane by one environment step."""
+        obs_batch = {
+            k: jnp.asarray(v)
+            for k, v in stack_observations([l.obs for l in self.lanes]).items()
+        }
+        carry = (jnp.asarray(self._carry[0]), jnp.asarray(self._carry[1]))
+        self._rng, key = jax.random.split(self._rng)
+        actions, logp, value, new_carry = self._step_fn(
+            self.params, obs_batch, carry, key
+        )
+        actions_np = {k: np.asarray(v) for k, v in actions.items()}
+        logp_np = np.asarray(logp)
+        # np.array (not asarray): device arrays view as read-only; the carry
+        # needs writable rows for per-lane episode resets.
+        self._carry = (np.array(new_carry[0]), np.array(new_carry[1]))
+
+        # Submit actions grouped per (env, team) — env steps once all agent
+        # teams have acted (env_api contract).
+        by_env_team: Dict[Tuple[int, int], List[pb.Action]] = {}
+        for i, lane in enumerate(self.lanes):
+            idx = {k: int(v[i]) for k, v in actions_np.items()}
+            lane.actions.append(idx)
+            lane.logps.append(float(logp_np[i]))
+            lane.obs_seq.append(lane.obs)
+            proto = decode_action(idx, lane.obs, lane.player_id)
+            by_env_team.setdefault((lane.env_idx, lane.team_id), []).append(proto)
+        for (env_idx, team_id), protos in by_env_team.items():
+            self.envs[env_idx].act(
+                pb.Actions(team_id=team_id, actions=protos)
+            )
+
+        # Observe, reward, detect episode/chunk boundaries.
+        T = self.config.ppo.rollout_len
+        for i, lane in enumerate(self.lanes):
+            env = self.envs[lane.env_idx]
+            resp = env.observe(lane.team_id)
+            ws = resp.world_state
+            r, _ = shaped_reward(lane.prev_ws, ws, lane.player_id)
+            done = env.done
+            lane.rewards.append(r)
+            lane.dones.append(1.0 if done else 0.0)
+            lane.episode_reward += r
+            lane.prev_ws = ws
+            lane.obs = self._featurize(ws, lane.player_id)
+            self.env_steps += 1
+            if done:
+                # Fresh episode ⇒ fresh recurrent state. Zero BEFORE
+                # finishing the chunk so the next chunk's carry0 snapshot
+                # (taken in _begin_chunk) sees the reset state.
+                self._carry[0][i] = 0.0
+                self._carry[1][i] = 0.0
+            if done or len(lane.actions) >= T:
+                self._finish_chunk(i, lane)
+            if done and lane is self._env_owner(lane.env_idx):
+                self._on_episode_end(lane.env_idx, ws)
+
+        # Reset envs whose episode finished (after all lanes shipped chunks).
+        for env_idx, env in enumerate(self.envs):
+            if env.done:
+                self._reset_env(env_idx, env)
+
+    def _env_owner(self, env_idx: int) -> _Lane:
+        """First lane of an env (used to count each episode once)."""
+        return next(l for l in self.lanes if l.env_idx == env_idx)
+
+    def _on_episode_end(self, env_idx: int, ws: pb.WorldState) -> None:
+        """Episode bookkeeping (carry zeroing happens at the done site in
+        ``step``; episode_reward resets in ``_reset_env``)."""
+        self.episodes_done += 1
+        owner = self._env_owner(env_idx)
+        self.episode_rewards.append(owner.episode_reward)
+        if ws.winning_team == owner.team_id:
+            self.wins += 1
+
+    def _finish_chunk(self, lane_idx: int, lane: _Lane) -> None:
+        """Pad, pack, and ship one rollout chunk."""
+        T = self.config.ppo.rollout_len
+        n = len(lane.actions)
+        assert 0 < n <= T
+        valid = [1.0] * n + [0.0] * (T - n)
+        # obs sequence: the n step observations + the current (bootstrap)
+        # obs, padded to T+1 by repeating the bootstrap.
+        obs_seq = lane.obs_seq + [lane.obs] * (T + 1 - n)
+        arrays = {
+            "obs": {
+                k: np.stack([d[k] for d in map(observation_to_dict, obs_seq)])
+                for k in observation_to_dict(obs_seq[0])
+            },
+            "actions": {
+                h: np.asarray(
+                    [a[h] for a in lane.actions] + [0] * (T - n), np.int32
+                )
+                for h in self.config.actions.head_sizes
+            },
+            "behavior_logp": np.asarray(
+                lane.logps + [0.0] * (T - n), np.float32
+            ),
+            "rewards": np.asarray(lane.rewards + [0.0] * (T - n), np.float32),
+            "dones": np.asarray(lane.dones + [1.0] * (T - n), np.float32),
+            "valid": np.asarray(valid, np.float32),
+            "carry0": (lane.carry0[0], lane.carry0[1]),
+        }
+        rollout = encode_rollout(
+            arrays,
+            model_version=lane.version0,
+            env_id=lane.env_idx,
+            rollout_id=self._next_rollout_id,
+            length=n,
+            total_reward=float(np.sum(lane.rewards)),
+        )
+        self._next_rollout_id += 1
+        if self.rollout_sink is not None:
+            self.rollout_sink(rollout)
+        elif self.transport is not None:
+            self.transport.publish_rollout(rollout)
+        self.rollouts_shipped += 1
+        self._begin_chunk(lane)
+
+    def run(self, n_steps: int, refresh_every: int = 8) -> Dict[str, float]:
+        """Drive the pool for ``n_steps`` batched steps; returns stats."""
+        for t in range(n_steps):
+            if refresh_every and t % refresh_every == 0:
+                self.refresh_weights()
+            self.step()
+        return self.stats()
+
+    def stats(self) -> Dict[str, float]:
+        recent = self.episode_rewards[-20:]
+        return {
+            "env_steps": float(self.env_steps),
+            "rollouts_shipped": float(self.rollouts_shipped),
+            "episodes_done": float(self.episodes_done),
+            "episode_reward_mean": float(np.mean(recent)) if recent else 0.0,
+            "win_rate": (
+                self.wins / self.episodes_done if self.episodes_done else 0.0
+            ),
+        }

@@ -61,8 +61,9 @@ class TrajectoryBuffer:
         self._write = 0            # next slot to write
         self._read = 0             # next slot to consume
         self._size = 0             # filled, unconsumed slots
-        self._versions = np.full((cap,), -1, dtype=np.int64)
+        self._warmed = False       # min_fill reached at least once
         self.dropped_stale = 0
+        self.dropped_overflow = 0
         self.ingested = 0
 
         self._scatter = jax.jit(
@@ -108,6 +109,11 @@ class TrajectoryBuffer:
                 self.dropped_stale += 1
                 continue
             fresh.append((meta, arrays))
+        if len(fresh) > self.capacity:
+            # A single scatter must not contain duplicate slot indices (the
+            # winning write would be undefined); keep only the newest.
+            self.dropped_overflow += len(fresh) - self.capacity
+            fresh = fresh[-self.capacity:]
         if not fresh:
             return 0
 
@@ -119,8 +125,6 @@ class TrajectoryBuffer:
             dtype=np.int32,
         )
         self._store = self._scatter(self._store, rows, jnp.asarray(idx))
-        for j, (meta, _) in zip(idx, fresh):
-            self._versions[j] = meta["model_version"]
         self._write = int((self._write + len(fresh)) % self.capacity)
         overflow = max(0, self._size + len(fresh) - self.capacity)
         if overflow:  # ring overwrote oldest unconsumed slots
@@ -133,8 +137,14 @@ class TrajectoryBuffer:
 
     def take(self, batch_size: Optional[int] = None) -> Optional[Dict[str, Any]]:
         """Consume the oldest ``batch_size`` rollouts as a train batch
-        (device arrays, batch-sharded). Returns None if underfilled."""
+        (device arrays, batch-sharded). Returns None if underfilled, or
+        before ``min_fill`` has been reached for the first time (warmup
+        diversity guard)."""
         b = batch_size or self.config.ppo.batch_rollouts
+        if not self._warmed:
+            if not self.ready:
+                return None
+            self._warmed = True
         if self._size < b:
             return None
         idx = np.array(
@@ -150,4 +160,5 @@ class TrajectoryBuffer:
             "buffer_size": float(self._size),
             "buffer_ingested": float(self.ingested),
             "buffer_dropped_stale": float(self.dropped_stale),
+            "buffer_dropped_overflow": float(self.dropped_overflow),
         }

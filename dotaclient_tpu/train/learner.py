@@ -1,0 +1,203 @@
+"""The learner: end-to-end training loop and CLI entrypoint.
+
+Counterpart of the reference's ``optimizer.py`` main loop — consume rollouts,
+train, publish versioned weights, checkpoint, log scalars (SURVEY.md §3.2;
+reconstructed — the reference checkout was an empty mount) — wired TPU-first:
+the actor pool batches env inference on-device, experience flows through the
+transport into the sharded HBM buffer, and each optimization is one donated
+pjit step (SURVEY.md §7 "Minimum end-to-end slice").
+
+Single-process mode interleaves actor and learner phases (the sandbox path);
+the same components run split across processes with an AMQP transport on a
+cluster (``--transport amqp``).
+
+Usage:
+    python -m dotaclient_tpu.train.learner --smoke       # tiny sanity run
+    python -m dotaclient_tpu.train.learner --steps 1000 --logdir runs/x
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import time
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from dotaclient_tpu.buffer import TrajectoryBuffer
+from dotaclient_tpu.config import RunConfig, default_config
+from dotaclient_tpu.actor import ActorPool
+from dotaclient_tpu.models import init_params, make_policy
+from dotaclient_tpu.parallel import make_mesh
+from dotaclient_tpu.train.ppo import init_train_state, make_train_step
+from dotaclient_tpu.transport import (
+    InProcTransport,
+    Transport,
+    decode_rollout,
+    encode_weights,
+)
+from dotaclient_tpu.utils.checkpoint import CheckpointManager
+from dotaclient_tpu.utils.metrics import MetricsLogger
+
+
+class Learner:
+    """Owns the full training stack for single-host runs."""
+
+    def __init__(
+        self,
+        config: RunConfig,
+        transport: Optional[Transport] = None,
+        logdir: Optional[str] = None,
+        checkpoint_dir: Optional[str] = None,
+        restore: bool = False,
+        seed: int = 0,
+    ) -> None:
+        self.config = config
+        self.mesh = make_mesh(config.mesh)
+        self.policy = make_policy(config.model, config.obs, config.actions)
+        params = init_params(self.policy, jax.random.PRNGKey(config.seed))
+        self.state = init_train_state(params, config.ppo)
+        self.ckpt: Optional[CheckpointManager] = None
+        if checkpoint_dir:
+            self.ckpt = CheckpointManager(checkpoint_dir)
+            if restore and self.ckpt.latest_step() is not None:
+                self.state, _ = self.ckpt.restore(config, self.state)
+        self.train_step = make_train_step(self.policy, config, self.mesh)
+        self.buffer = TrajectoryBuffer(config, self.mesh)
+        self.transport = transport or InProcTransport()
+        self.pool = ActorPool(
+            config,
+            self.policy,
+            self.state.params,
+            transport=self.transport,
+            seed=seed,
+            version=int(self.state.version),
+        )
+        self.metrics = MetricsLogger(logdir)
+        self.frames_per_rollout = config.ppo.rollout_len
+        self._last_metrics: Dict[str, float] = {}
+
+    # -- loop --------------------------------------------------------------
+
+    def ingest(self) -> int:
+        protos = self.transport.consume_rollouts(
+            self.config.buffer.capacity_rollouts, timeout=0.001
+        )
+        if not protos:
+            return 0
+        return self.buffer.add(
+            [decode_rollout(p) for p in protos], int(self.state.version)
+        )
+
+    def train(self, num_steps: int, actor_steps_per_iter: Optional[int] = None) -> Dict[str, float]:
+        """Run until ``num_steps`` optimizer steps have completed."""
+        cfg = self.config
+        actor_steps = actor_steps_per_iter or cfg.ppo.rollout_len
+        t_start = time.time()
+        frames_trained = 0
+        steps_done = 0
+        while steps_done < num_steps:
+            # Actor phase: generate experience with the current weights.
+            self.pool.set_params(self.state.params, int(self.state.version))
+            self.pool.run(actor_steps, refresh_every=0)
+            self.ingest()
+            # Learner phase: drain full batches.
+            while (batch := self.buffer.take()) is not None:
+                self.state, m = self.train_step(self.state, batch)
+                steps_done += 1
+                frames_trained += cfg.ppo.batch_rollouts * cfg.ppo.rollout_len
+                step = int(self.state.step)
+                if step % cfg.log_every == 0:
+                    scalars = {k: float(np.asarray(v)) for k, v in m.items()}
+                    scalars.update(self.pool.stats())
+                    scalars.update(self.buffer.metrics())
+                    elapsed = time.time() - t_start
+                    scalars["frames_per_sec"] = frames_trained / max(elapsed, 1e-9)
+                    self._last_metrics = scalars
+                    self.metrics.log(step, scalars)
+                if self.ckpt and step % cfg.checkpoint_every == 0:
+                    self.ckpt.save(self.state, cfg)
+                if steps_done >= num_steps:
+                    break
+        # Publish final weights for out-of-process actors (cluster parity).
+        self.transport.publish_weights(
+            encode_weights(
+                jax.tree.map(np.asarray, self.state.params),
+                int(self.state.version),
+            )
+        )
+        if self.ckpt:
+            self.ckpt.save(self.state, cfg, force=True)
+            self.ckpt.wait()
+        elapsed = time.time() - t_start
+        return {
+            **self._last_metrics,
+            **{f"actor_{k}": v for k, v in self.pool.stats().items()},
+            # Fresh end-of-run figures last so they win over logged snapshots.
+            "optimizer_steps": float(steps_done),
+            "frames_trained": float(frames_trained),
+            "frames_per_sec": frames_trained / max(elapsed, 1e-9),
+            "elapsed_sec": elapsed,
+        }
+
+
+def main(argv=None) -> Dict[str, float]:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--smoke", action="store_true", help="tiny fast config")
+    p.add_argument("--logdir", type=str, default=None)
+    p.add_argument("--checkpoint-dir", type=str, default=None)
+    p.add_argument("--restore", action="store_true")
+    p.add_argument("--n-envs", type=int, default=None)
+    p.add_argument("--opponent", type=str, default=None)
+    p.add_argument("--team-size", type=int, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    config = default_config()
+    if args.smoke:
+        config = dataclasses.replace(
+            config,
+            env=dataclasses.replace(config.env, n_envs=4, max_dota_time=60.0),
+            ppo=dataclasses.replace(
+                config.ppo, rollout_len=8, batch_rollouts=8
+            ),
+            buffer=dataclasses.replace(
+                config.buffer, capacity_rollouts=32, min_fill=8
+            ),
+            log_every=1,
+        )
+        args.steps = min(args.steps, 5)
+    env_over = {}
+    if args.n_envs is not None:
+        env_over["n_envs"] = args.n_envs
+    if args.opponent is not None:
+        env_over["opponent"] = args.opponent
+    if args.team_size is not None:
+        env_over["team_size"] = args.team_size
+    if env_over:
+        config = dataclasses.replace(
+            config, env=dataclasses.replace(config.env, **env_over)
+        )
+
+    learner = Learner(
+        config,
+        logdir=args.logdir,
+        checkpoint_dir=args.checkpoint_dir,
+        restore=args.restore,
+        seed=args.seed,
+    )
+    stats = learner.train(args.steps)
+    print(
+        f"done: {stats['optimizer_steps']:.0f} steps, "
+        f"{stats['frames_trained']:.0f} frames, "
+        f"{stats['frames_per_sec']:.0f} frames/sec",
+        flush=True,
+    )
+    return stats
+
+
+if __name__ == "__main__":
+    main()

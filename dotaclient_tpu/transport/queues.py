@@ -43,22 +43,26 @@ class InProcTransport:
 
     def __init__(self, max_rollouts: int = 4096) -> None:
         self._rollouts: "queue.Queue[pb.Rollout]" = queue.Queue(max_rollouts)
+        self._publish_lock = threading.Lock()
         self._weights_lock = threading.Lock()
         self._weights: Optional[pb.ModelWeights] = None
         self.dropped = 0
 
     def publish_rollout(self, rollout: pb.Rollout) -> None:
-        try:
-            self._rollouts.put_nowait(rollout)
-        except queue.Full:
-            # Actors must never block on a slow learner (the reference relies
-            # on RMQ buffering; here backpressure = drop-oldest).
-            try:
-                self._rollouts.get_nowait()
-                self.dropped += 1
-            except queue.Empty:
-                pass
-            self._rollouts.put_nowait(rollout)
+        # Actors must never block on a slow learner (the reference relies on
+        # RMQ buffering; here backpressure = drop-oldest). The lock makes the
+        # evict-then-put atomic across concurrent publishers.
+        with self._publish_lock:
+            while True:
+                try:
+                    self._rollouts.put_nowait(rollout)
+                    return
+                except queue.Full:
+                    try:
+                        self._rollouts.get_nowait()
+                        self.dropped += 1
+                    except queue.Empty:
+                        pass
 
     def consume_rollouts(
         self, max_count: int, timeout: Optional[float] = None

@@ -1,0 +1,6 @@
+"""Ops utilities: metrics, checkpointing, profiling, debug."""
+
+from dotaclient_tpu.utils.checkpoint import CheckpointManager
+from dotaclient_tpu.utils.metrics import MetricsLogger
+
+__all__ = ["CheckpointManager", "MetricsLogger"]

@@ -1,0 +1,102 @@
+"""Orbax checkpointing of the full learner state.
+
+The reference does ``torch.save(state_dict)`` every K steps with a resume
+flag (SURVEY.md §5.4; reconstructed — the reference checkout was an empty
+mount). Here a checkpoint restores the *exact* training step: params,
+optimizer state, step/version counters, and the config that produced them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from dotaclient_tpu.config import RunConfig
+from dotaclient_tpu.train.ppo import TrainState, init_train_state
+
+
+class CheckpointManager:
+    """Thin orbax wrapper with the repo's state layout."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3) -> None:
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, state: TrainState, config: RunConfig, force: bool = False) -> bool:
+        step = int(state.step)
+        saved = self._mgr.save(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(
+                    {
+                        "step": np.asarray(state.step),
+                        "version": np.asarray(state.version),
+                        "params": jax.tree.map(np.asarray, state.params),
+                        "opt_state": jax.tree.map(np.asarray, state.opt_state),
+                    }
+                ),
+                config=ocp.args.JsonSave(dataclasses.asdict(config)),
+            ),
+            force=force,
+        )
+        return bool(saved)
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(
+        self, config: RunConfig, abstract_state: Optional[TrainState] = None
+    ) -> Tuple[TrainState, RunConfig]:
+        """Restore the latest checkpoint into a TrainState.
+
+        ``abstract_state`` provides the target pytree structure; built from
+        ``config`` when omitted.
+        """
+        step = self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        if abstract_state is None:
+            from dotaclient_tpu.models import init_params, make_policy
+
+            policy = make_policy(config.model, config.obs, config.actions)
+            params = init_params(policy, jax.random.PRNGKey(0))
+            abstract_state = init_train_state(params, config.ppo)
+        template = {
+            "step": np.asarray(abstract_state.step),
+            "version": np.asarray(abstract_state.version),
+            "params": jax.tree.map(np.asarray, abstract_state.params),
+            "opt_state": jax.tree.map(np.asarray, abstract_state.opt_state),
+        }
+        restored = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(template),
+                config=ocp.args.JsonRestore(),
+            ),
+        )
+        raw = restored["state"]
+        state = TrainState(
+            step=jax.numpy.asarray(raw["step"]),
+            version=jax.numpy.asarray(raw["version"]),
+            params=jax.tree.map(jax.numpy.asarray, raw["params"]),
+            opt_state=jax.tree.map(jax.numpy.asarray, raw["opt_state"]),
+        )
+        cfg = RunConfig.from_json(__import__("json").dumps(restored["config"]))
+        return state, cfg
+
+    def close(self) -> None:
+        self._mgr.close()

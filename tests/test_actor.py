@@ -1,0 +1,207 @@
+"""Actor-runtime tests: chunk contract, episode boundaries, weight refresh,
+and the full actor→transport→buffer→learner loop (SURVEY.md §7 step 6)."""
+
+import dataclasses
+
+import numpy as np
+import jax
+import pytest
+
+from dotaclient_tpu.actor import ActorPool, build_game_config
+from dotaclient_tpu.buffer import TrajectoryBuffer
+from dotaclient_tpu.config import RunConfig
+from dotaclient_tpu.models import init_params, make_policy
+from dotaclient_tpu.parallel import make_mesh
+from dotaclient_tpu.protos import dota_pb2 as pb
+from dotaclient_tpu.train import init_train_state, make_train_step
+from dotaclient_tpu.transport import (
+    InProcTransport,
+    decode_rollout,
+    encode_weights,
+)
+
+
+def small_config(**env_kw) -> RunConfig:
+    cfg = RunConfig()
+    env_kw = {"n_envs": 2, "max_dota_time": 30.0, **env_kw}
+    return dataclasses.replace(
+        cfg,
+        model=dataclasses.replace(cfg.model, dtype="float32"),
+        env=dataclasses.replace(cfg.env, **env_kw),
+        ppo=dataclasses.replace(cfg.ppo, rollout_len=8, batch_rollouts=8),
+        buffer=dataclasses.replace(cfg.buffer, capacity_rollouts=64, min_fill=8),
+    )
+
+
+@pytest.fixture(scope="module")
+def policy_params():
+    cfg = small_config()
+    policy = make_policy(cfg.model, cfg.obs, cfg.actions)
+    params = init_params(policy, jax.random.PRNGKey(0))
+    return policy, params
+
+
+def make_pool(cfg, policy, params, **kw):
+    return ActorPool(cfg, policy, params, **kw)
+
+
+class TestGameConfig:
+    def test_1v1_scripted(self):
+        cfg = small_config()
+        gc = build_game_config(cfg, seed=0)
+        assert len(gc.hero_picks) == 2
+        assert gc.hero_picks[0].control_mode == pb.CONTROL_AGENT
+        assert gc.hero_picks[1].control_mode == pb.CONTROL_SCRIPTED_EASY
+
+    def test_selfplay_5v5(self):
+        cfg = small_config(team_size=5, opponent="selfplay")
+        gc = build_game_config(cfg, seed=0)
+        assert len(gc.hero_picks) == 10
+        assert all(p.control_mode == pb.CONTROL_AGENT for p in gc.hero_picks)
+
+    def test_hero_pool_sampling(self):
+        cfg = small_config(hero_pool=(1, 2, 3))
+        ids = {
+            build_game_config(cfg, seed=s).hero_picks[0].hero_id
+            for s in range(20)
+        }
+        assert ids == {1, 2, 3}
+
+
+class TestRolloutContract:
+    def test_chunk_shapes_and_versions(self, policy_params):
+        policy, params = policy_params
+        cfg = small_config()
+        shipped = []
+        pool = make_pool(cfg, policy, params, rollout_sink=shipped.append,
+                         version=3)
+        pool.run(cfg.ppo.rollout_len, refresh_every=0)
+        assert len(shipped) == 2  # one per lane, chunks full at T
+        T = cfg.ppo.rollout_len
+        for r in shipped:
+            assert r.model_version == 3
+            assert r.length == T
+            meta, arrays = decode_rollout(r)
+            assert arrays["obs"]["units"].shape[0] == T + 1
+            assert arrays["rewards"].shape == (T,)
+            assert arrays["valid"].sum() == T
+            assert arrays["carry0"][0].shape == (cfg.model.hidden_dim,)
+            # first chunk of an episode starts from zero state
+            np.testing.assert_array_equal(arrays["carry0"][0], 0.0)
+
+    def test_second_chunk_carries_state(self, policy_params):
+        policy, params = policy_params
+        cfg = small_config()
+        shipped = []
+        pool = make_pool(cfg, policy, params, rollout_sink=shipped.append)
+        pool.run(2 * cfg.ppo.rollout_len, refresh_every=0)
+        by_env = {}
+        for r in shipped:
+            by_env.setdefault(r.env_id, []).append(r)
+        for env_id, rolls in by_env.items():
+            assert len(rolls) == 2
+            _, arrays = decode_rollout(rolls[1])
+            # second chunk of a live episode must carry nonzero LSTM state
+            assert np.abs(arrays["carry0"][0]).sum() > 0
+
+    def test_episode_end_ships_padded_chunk(self, policy_params):
+        policy, params = policy_params
+        cfg = small_config(max_dota_time=5.0)  # 25 steps @0.2s > chunk of 8
+        shipped = []
+        pool = make_pool(cfg, policy, params, rollout_sink=shipped.append)
+        pool.run(30, refresh_every=0)
+        assert pool.episodes_done >= 2
+        # some chunk must be padded (episode length 25 = 8+8+8+1)
+        padded = []
+        for r in shipped:
+            _, arrays = decode_rollout(r)
+            if arrays["valid"].sum() < cfg.ppo.rollout_len:
+                padded.append(arrays)
+        assert padded, "expected at least one early-shipped padded chunk"
+        for arrays in padded:
+            n = int(arrays["valid"].sum())
+            # done flag set at the last valid step; padding is marked done
+            assert arrays["dones"][n - 1] == 1.0
+            # after an episode a fresh chunk starts from zero carry
+        # every post-reset chunk must restart from zeros
+        first_chunks = [
+            decode_rollout(r)[1] for r in shipped
+            if decode_rollout(r)[1]["valid"].sum() == cfg.ppo.rollout_len
+        ]
+        assert first_chunks
+
+    def test_behavior_logp_is_negative_on_valid_steps(self, policy_params):
+        policy, params = policy_params
+        cfg = small_config()
+        shipped = []
+        pool = make_pool(cfg, policy, params, rollout_sink=shipped.append)
+        pool.run(cfg.ppo.rollout_len, refresh_every=0)
+        for r in shipped:
+            _, arrays = decode_rollout(r)
+            valid = arrays["valid"].astype(bool)
+            assert (arrays["behavior_logp"][valid] <= 0).all()
+
+
+class TestWeightRefresh:
+    def test_refresh_from_transport(self, policy_params):
+        policy, params = policy_params
+        cfg = small_config()
+        transport = InProcTransport()
+        pool = make_pool(cfg, policy, params, transport=transport, version=0)
+        new_params = jax.tree.map(lambda x: x + 1.0, params)
+        transport.publish_weights(
+            encode_weights(jax.tree.map(np.asarray, new_params), version=5)
+        )
+        assert pool.refresh_weights()
+        assert pool.version == 5
+        leaf_old = jax.tree.leaves(params)[0]
+        leaf_new = jax.tree.leaves(pool.params)[0]
+        np.testing.assert_allclose(
+            np.asarray(leaf_new), np.asarray(leaf_old) + 1.0, rtol=1e-6
+        )
+
+    def test_noop_without_new_weights(self, policy_params):
+        policy, params = policy_params
+        cfg = small_config()
+        pool = make_pool(cfg, policy, params, transport=InProcTransport())
+        assert not pool.refresh_weights()
+
+
+class TestSelfplay:
+    def test_selfplay_lanes_and_rollouts(self, policy_params):
+        policy, params = policy_params
+        cfg = small_config(opponent="selfplay")
+        shipped = []
+        pool = make_pool(cfg, policy, params, rollout_sink=shipped.append)
+        assert len(pool.lanes) == 4  # 2 envs x 2 teams
+        pool.run(cfg.ppo.rollout_len, refresh_every=0)
+        assert len(shipped) == 4
+        teams = {decode_rollout(r)[0]["env_id"] for r in shipped}
+        assert teams == {0, 1}
+
+
+class TestEndToEnd:
+    def test_actor_to_learner_loop_runs(self, policy_params):
+        """Full slice: pool → transport → buffer → train step → weight
+        refresh → more rollouts (SURVEY.md §7 'minimum end-to-end slice')."""
+        policy, params = policy_params
+        cfg = small_config()
+        mesh = make_mesh(cfg.mesh)
+        transport = InProcTransport()
+        pool = make_pool(cfg, policy, params, transport=transport)
+        buf = TrajectoryBuffer(cfg, mesh)
+        state = init_train_state(params, cfg.ppo)
+        step = make_train_step(policy, cfg, mesh)
+
+        n_train_steps = 0
+        for _ in range(8):
+            pool.run(cfg.ppo.rollout_len, refresh_every=0)
+            protos = transport.consume_rollouts(64, timeout=0.01)
+            buf.add([decode_rollout(p) for p in protos], int(state.version))
+            while (batch := buf.take()) is not None:
+                state, metrics = step(state, batch)
+                n_train_steps += 1
+                assert np.isfinite(float(metrics["loss"]))
+            pool.set_params(state.params, int(state.version))
+        assert n_train_steps >= 2
+        assert pool.version == int(state.version)

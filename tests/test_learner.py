@@ -1,0 +1,96 @@
+"""Learner-loop + checkpoint/resume tests (SURVEY.md §5.4, §7 e2e slice)."""
+
+import dataclasses
+
+import numpy as np
+import jax
+import pytest
+
+from dotaclient_tpu.config import RunConfig
+from dotaclient_tpu.models import init_params, make_policy
+from dotaclient_tpu.train.learner import Learner
+from dotaclient_tpu.train.ppo import init_train_state
+from dotaclient_tpu.utils.checkpoint import CheckpointManager
+
+
+def tiny_config() -> RunConfig:
+    cfg = RunConfig()
+    return dataclasses.replace(
+        cfg,
+        env=dataclasses.replace(cfg.env, n_envs=2, max_dota_time=30.0),
+        ppo=dataclasses.replace(cfg.ppo, rollout_len=8, batch_rollouts=8),
+        buffer=dataclasses.replace(cfg.buffer, capacity_rollouts=32, min_fill=8),
+        log_every=1000,  # silence console in tests
+        checkpoint_every=1000,
+    )
+
+
+class TestLearnerLoop:
+    def test_trains_and_publishes_weights(self):
+        learner = Learner(tiny_config())
+        stats = learner.train(3)
+        assert stats["optimizer_steps"] == 3
+        assert stats["frames_trained"] == 3 * 8 * 8
+        assert int(learner.state.step) == 3
+        # final weights published for out-of-process actors
+        msg = learner.transport.latest_weights()
+        assert msg is not None and msg.version == 3
+        # in-process pool got refreshed along the way
+        assert learner.pool.version >= 2
+
+
+class TestCheckpoint:
+    def test_save_restore_roundtrip(self, tmp_path):
+        cfg = tiny_config()
+        policy = make_policy(cfg.model, cfg.obs, cfg.actions)
+        params = init_params(policy, jax.random.PRNGKey(0))
+        state = init_train_state(params, cfg.ppo)
+        state = dataclasses.replace(
+            state,
+            step=jax.numpy.asarray(7, jax.numpy.int32),
+            version=jax.numpy.asarray(7, jax.numpy.int32),
+        )
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        assert mgr.save(state, cfg, force=True)
+        mgr.wait()
+        assert mgr.latest_step() == 7
+
+        restored, rcfg = mgr.restore(cfg)
+        assert int(restored.step) == 7
+        assert int(restored.version) == 7
+        assert rcfg.ppo.rollout_len == cfg.ppo.rollout_len
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            restored.params,
+            state.params,
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            restored.opt_state,
+            state.opt_state,
+        )
+        mgr.close()
+
+    def test_learner_resume_continues_step_count(self, tmp_path):
+        ckpt_dir = str(tmp_path / "ckpt")
+        cfg = tiny_config()
+        learner = Learner(cfg, checkpoint_dir=ckpt_dir)
+        learner.train(2)
+        learner.ckpt.wait()
+        assert learner.ckpt.latest_step() == 2
+
+        resumed = Learner(cfg, checkpoint_dir=ckpt_dir, restore=True)
+        assert int(resumed.state.step) == 2
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            resumed.state.params,
+            learner.state.params,
+        )
+        resumed.train(1)
+        assert int(resumed.state.step) == 3
