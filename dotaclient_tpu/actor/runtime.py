@@ -19,6 +19,17 @@ transport, SURVEY.md §5.7, and the learner's ``train.ppo.Batch`` contract):
 Weight refresh follows the reference's hot-swap discipline (SURVEY.md §3.4):
 the pool polls the transport for the latest published weights between steps
 and bumps its version tag.
+
+Host↔device discipline (the round-1 bottleneck, SURVEY.md §7 hard-part 2):
+exactly ONE jitted dispatch and ONE host fetch per env step. Host numpy
+arrays are passed straight into the jitted call (the transfer rides the
+async dispatch path — orders of magnitude cheaper here than an explicit,
+synchronizing ``device_put``), the recurrent carry and the PRNG key stay
+device-resident between steps (episode resets are applied inside the step
+via a mask; the key is split inside), and everything the host loop needs —
+packed actions, log-probs, and an f32 carry copy for ``carry0`` snapshots —
+comes back in a single ``jax.device_get``. On latency-dominated links the
+per-step cost is one round trip, independent of lane count.
 """
 
 from __future__ import annotations
@@ -126,11 +137,14 @@ class ActorPool:
     ) -> None:
         self.config = config
         self.policy = policy
-        self.params = params
-        self.version = version
+        # (params, version) swap atomically as one tuple: the learner thread
+        # may refresh weights while the actor thread is mid-step, and a chunk
+        # must never be tagged with a version newer than the params that
+        # produced it (the staleness filter keys on the tag).
+        self._weights = (params, version)
+        self._chunk_version = version
         self.transport = transport
         self.rollout_sink = rollout_sink
-        self._rng = jax.random.PRNGKey(seed)
         self._seed = seed
         self._next_rollout_id = 0
         self._next_game_seed = seed * 100_003
@@ -142,12 +156,14 @@ class ActorPool:
         for i, env in enumerate(self.envs):
             self._reset_env(i, env)
         n = len(self.lanes)
-        self._carry = (
-            np.zeros((n, config.model.hidden_dim), np.float32),
-            np.zeros((n, config.model.hidden_dim), np.float32),
-        )
-        for lane in self.lanes:
-            self._begin_chunk(lane)
+        H = config.model.hidden_dim
+        # Device-resident recurrent state + PRNG key (never pulled per step).
+        self._carry_dev = policy.initial_state(n)
+        self._key_dev = jax.random.PRNGKey(seed)
+        self._reset_mask = np.zeros((n,), np.bool_)
+        zeros_row = np.zeros((H,), np.float32)
+        for i, lane in enumerate(self.lanes):
+            self._begin_chunk(lane, (zeros_row, zeros_row))
 
         self._step_fn = jax.jit(self._device_step)
         # throughput counters
@@ -196,24 +212,43 @@ class ActorPool:
     def _featurize(self, ws: pb.WorldState, player_id: int) -> Observation:
         return featurize(ws, player_id, self.config.obs, self.config.actions)
 
-    def _begin_chunk(self, lane: _Lane) -> None:
-        i = self.lanes.index(lane)
+    def _begin_chunk(
+        self, lane: _Lane, carry0: Tuple[np.ndarray, np.ndarray]
+    ) -> None:
         lane.obs_seq = []
         lane.actions = []
         lane.logps = []
         lane.rewards = []
         lane.dones = []
-        lane.carry0 = (self._carry[0][i].copy(), self._carry[1][i].copy())
-        lane.version0 = self.version
+        lane.carry0 = (
+            np.asarray(carry0[0], np.float32).copy(),
+            np.asarray(carry0[1], np.float32).copy(),
+        )
+        lane.version0 = self._chunk_version
 
     # -- device step -------------------------------------------------------
 
-    def _device_step(self, params, obs_batch, carry, rng):
-        logits, value, new_carry = self.policy.apply(
+    def _device_step(self, params, obs_batch, carry, key, reset_mask):
+        """One batched actor step, fully on device: zero carry rows for lanes
+        whose episode just ended, split the key, forward + sample. Outputs are
+        split into a host-bound group (packed actions, logp, f32 carry for
+        ``carry0`` snapshots — fetched together as ONE transfer) and the
+        device-resident group (carry, key) that never leaves HBM."""
+        key, sub = jax.random.split(key)
+        keep = jnp.logical_not(reset_mask)[:, None].astype(carry[0].dtype)
+        carry = (carry[0] * keep, carry[1] * keep)
+        logits, _, new_carry = self.policy.apply(
             params, obs_batch, carry, method="step"
         )
-        actions, logp = D.sample(rng, logits, obs_batch)
-        return actions, logp, value, new_carry
+        actions, logp = D.sample(sub, logits, obs_batch)
+        packed = jnp.stack(
+            [actions[h] for h in D.HEADS], axis=1
+        ).astype(jnp.int32)
+        carry_f32 = (
+            new_carry[0].astype(jnp.float32),
+            new_carry[1].astype(jnp.float32),
+        )
+        return (packed, logp, carry_f32), (new_carry, key)
 
     # -- public API --------------------------------------------------------
 
@@ -225,38 +260,45 @@ class ActorPool:
         if msg is None or msg.version == self.version:
             return False
         version, tree = decode_weights(msg)
-        self.params = jax.tree.map(jnp.asarray, tree)
-        self.version = version
+        self._weights = (jax.tree.map(jnp.asarray, tree), version)
         return True
 
     def set_params(self, params: Any, version: int) -> None:
         """Direct replicated-params refresh (in-process learner path — the
         'actors read replicated JAX params' mode of BASELINE.json:5)."""
-        self.params = params
-        self.version = version
+        self._weights = (params, version)
+
+    @property
+    def params(self) -> Any:
+        return self._weights[0]
+
+    @property
+    def version(self) -> int:
+        return self._weights[1]
 
     def step(self) -> None:
         """Advance every lane by one environment step."""
-        obs_batch = {
-            k: jnp.asarray(v)
-            for k, v in stack_observations([l.obs for l in self.lanes]).items()
-        }
-        carry = (jnp.asarray(self._carry[0]), jnp.asarray(self._carry[1]))
-        self._rng, key = jax.random.split(self._rng)
-        actions, logp, value, new_carry = self._step_fn(
-            self.params, obs_batch, carry, key
+        obs_batch = stack_observations([l.obs for l in self.lanes])
+        # One atomic weights read serves the whole step: dispatch uses these
+        # params, and chunks beginning this step are tagged with this version.
+        params, self._chunk_version = self._weights
+        host_out, (self._carry_dev, self._key_dev) = self._step_fn(
+            params,
+            obs_batch,
+            self._carry_dev,
+            self._key_dev,
+            self._reset_mask,
         )
-        actions_np = {k: np.asarray(v) for k, v in actions.items()}
-        logp_np = np.asarray(logp)
-        # np.array (not asarray): device arrays view as read-only; the carry
-        # needs writable rows for per-lane episode resets.
-        self._carry = (np.array(new_carry[0]), np.array(new_carry[1]))
+        # ONE host transfer for everything the host loop needs this step —
+        # per-array fetches each pay a full device round trip.
+        actions_np, logp_np, carry_np = jax.device_get(host_out)
+        self._reset_mask[:] = False
 
         # Submit actions grouped per (env, team) — env steps once all agent
         # teams have acted (env_api contract).
         by_env_team: Dict[Tuple[int, int], List[pb.Action]] = {}
         for i, lane in enumerate(self.lanes):
-            idx = {k: int(v[i]) for k, v in actions_np.items()}
+            idx = {h: int(actions_np[i, j]) for j, h in enumerate(D.HEADS)}
             lane.actions.append(idx)
             lane.logps.append(float(logp_np[i]))
             lane.obs_seq.append(lane.obs)
@@ -269,6 +311,7 @@ class ActorPool:
 
         # Observe, reward, detect episode/chunk boundaries.
         T = self.config.ppo.rollout_len
+        finished: List[Tuple[int, _Lane, bool]] = []
         for i, lane in enumerate(self.lanes):
             env = self.envs[lane.env_idx]
             resp = env.observe(lane.team_id)
@@ -282,15 +325,26 @@ class ActorPool:
             lane.obs = self._featurize(ws, lane.player_id)
             self.env_steps += 1
             if done:
-                # Fresh episode ⇒ fresh recurrent state. Zero BEFORE
-                # finishing the chunk so the next chunk's carry0 snapshot
-                # (taken in _begin_chunk) sees the reset state.
-                self._carry[0][i] = 0.0
-                self._carry[1][i] = 0.0
+                # Fresh episode ⇒ fresh recurrent state: the device step
+                # zeroes this row on the NEXT call, and the new chunk's
+                # carry0 snapshot below is zeros to match.
+                self._reset_mask[i] = True
             if done or len(lane.actions) >= T:
-                self._finish_chunk(i, lane)
+                finished.append((i, lane, done))
             if done and lane is self._env_owner(lane.env_idx):
                 self._on_episode_end(lane.env_idx, ws)
+
+        if finished:
+            H = self.config.model.hidden_dim
+            zeros_row = np.zeros((H,), np.float32)
+            for i, lane, done in finished:
+                self._finish_chunk(i, lane)
+                carry0 = (
+                    (zeros_row, zeros_row)
+                    if done
+                    else (carry_np[0][i], carry_np[1][i])
+                )
+                self._begin_chunk(lane, carry0)
 
         # Reset envs whose episode finished (after all lanes shipped chunks).
         for env_idx, env in enumerate(self.envs):
@@ -352,7 +406,6 @@ class ActorPool:
         elif self.transport is not None:
             self.transport.publish_rollout(rollout)
         self.rollouts_shipped += 1
-        self._begin_chunk(lane)
 
     def run(self, n_steps: int, refresh_every: int = 8) -> Dict[str, float]:
         """Drive the pool for ``n_steps`` batched steps; returns stats."""

@@ -18,10 +18,10 @@ single-writer discipline the reference gets from its one blocking consumer).
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Tuple
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -57,11 +57,19 @@ class TrajectoryBuffer:
         self._store = jax.tree.map(
             lambda x: jax.device_put(x, self._sharding), template
         )
-        # Host-side ring bookkeeping.
-        self._write = 0            # next slot to write
-        self._read = 0             # next slot to consume
-        self._size = 0             # filled, unconsumed slots
+        # Host-side bookkeeping: consumption order is an explicit deque of
+        # slot ids (oldest first) plus a free list — NOT ring-cursor
+        # arithmetic. Chunk versions are not monotone in ship order (an
+        # episode-end chunk ships early with a newer version than a longer
+        # chunk still in flight), so consume-time staleness drops must be
+        # able to remove arbitrary slots, not just the head.
+        self._order: Deque[int] = deque()
+        self._free: List[int] = list(range(cap - 1, -1, -1))
         self._warmed = False       # min_fill reached at least once
+        # Per-slot producer version, host-side: staleness is re-checked at
+        # consume time too — a rollout that was fresh at ingest can go stale
+        # sitting in the ring while the learner trains (ADVICE round 1).
+        self._slot_version = np.zeros((cap,), np.int64)
         self.dropped_stale = 0
         self.dropped_overflow = 0
         self.ingested = 0
@@ -82,11 +90,11 @@ class TrajectoryBuffer:
 
     @property
     def size(self) -> int:
-        return self._size
+        return len(self._order)
 
     @property
     def ready(self) -> bool:
-        return self._size >= max(
+        return self.size >= max(
             self.config.buffer.min_fill, self.config.ppo.batch_rollouts
         )
 
@@ -120,44 +128,80 @@ class TrajectoryBuffer:
         rows = jax.tree.map(
             lambda *xs: np.stack(xs), *[arrays for _, arrays in fresh]
         )
-        idx = np.array(
-            [(self._write + i) % self.capacity for i in range(len(fresh))],
-            dtype=np.int32,
-        )
-        self._store = self._scatter(self._store, rows, jnp.asarray(idx))
-        self._write = int((self._write + len(fresh)) % self.capacity)
-        overflow = max(0, self._size + len(fresh) - self.capacity)
-        if overflow:  # ring overwrote oldest unconsumed slots
-            self._read = int((self._read + overflow) % self.capacity)
-        self._size = min(self._size + len(fresh), self.capacity)
+        # Allocate slots: free ones first, then evict oldest unconsumed.
+        slots = []
+        for _ in fresh:
+            if self._free:
+                slots.append(self._free.pop())
+            else:
+                slots.append(self._order.popleft())
+                self.dropped_overflow += 1
+        idx = np.asarray(slots, dtype=np.int32)
+        # Scatter in power-of-two chunks (binary decomposition of the ingest
+        # count): a varying leading dim would compile one XLA program per
+        # distinct count — up to `capacity` of them (ADVICE round 1). This
+        # bounds it at log2(capacity) programs. numpy rows transfer on the
+        # dispatch path (no separate synchronizing device_put).
+        pos = 0
+        remaining = len(fresh)
+        while remaining:
+            chunk = 1 << (remaining.bit_length() - 1)
+            rows_chunk = jax.tree.map(lambda r: r[pos:pos + chunk], rows)
+            self._store = self._scatter(
+                self._store, rows_chunk, idx[pos:pos + chunk]
+            )
+            pos += chunk
+            remaining -= chunk
+        self._slot_version[idx] = [m["model_version"] for m, _ in fresh]
+        self._order.extend(slots)
         self.ingested += len(fresh)
         return len(fresh)
 
     # -- consume -----------------------------------------------------------
 
-    def take(self, batch_size: Optional[int] = None) -> Optional[Dict[str, Any]]:
+    def take(
+        self,
+        batch_size: Optional[int] = None,
+        current_version: Optional[int] = None,
+    ) -> Optional[Dict[str, Any]]:
         """Consume the oldest ``batch_size`` rollouts as a train batch
         (device arrays, batch-sharded). Returns None if underfilled, or
         before ``min_fill`` has been reached for the first time (warmup
-        diversity guard)."""
+        diversity guard).
+
+        When ``current_version`` is given, staleness is re-enforced here:
+        every unconsumed slot whose producer version has fallen more than
+        ``max_staleness`` behind is dropped (slots are scanned, not just the
+        head — ship order does not imply version order).
+        """
         b = batch_size or self.config.ppo.batch_rollouts
+        if current_version is not None:
+            max_st = self.config.ppo.max_staleness
+            stale = [
+                s for s in self._order
+                if current_version - self._slot_version[s] > max_st
+            ]
+            if stale:
+                stale_set = set(stale)
+                self._order = deque(
+                    s for s in self._order if s not in stale_set
+                )
+                self._free.extend(stale)
+                self.dropped_stale += len(stale)
         if not self._warmed:
             if not self.ready:
                 return None
             self._warmed = True
-        if self._size < b:
+        if self.size < b:
             return None
-        idx = np.array(
-            [(self._read + i) % self.capacity for i in range(b)], dtype=np.int32
-        )
-        batch = self._gather(self._store, jnp.asarray(idx))
-        self._read = int((self._read + b) % self.capacity)
-        self._size -= b
+        idx = np.asarray([self._order.popleft() for _ in range(b)], np.int32)
+        batch = self._gather(self._store, idx)
+        self._free.extend(int(s) for s in idx)
         return batch
 
     def metrics(self) -> Dict[str, float]:
         return {
-            "buffer_size": float(self._size),
+            "buffer_size": float(self.size),
             "buffer_ingested": float(self.ingested),
             "buffer_dropped_stale": float(self.dropped_stale),
             "buffer_dropped_overflow": float(self.dropped_overflow),

@@ -7,9 +7,19 @@ the actor pool batches env inference on-device, experience flows through the
 transport into the sharded HBM buffer, and each optimization is one donated
 pjit step (SURVEY.md §7 "Minimum end-to-end slice").
 
-Single-process mode interleaves actor and learner phases (the sandbox path);
-the same components run split across processes with an AMQP transport on a
-cluster (``--transport amqp``).
+Single-process mode interleaves actor and learner phases (the deterministic
+test path) or overlaps them (``--overlap``: the actor pool runs in its own
+thread feeding the transport while the learner trains — the async
+actor-learner topology of SURVEY.md §1, in one process). The same components
+run split across processes with an AMQP transport on a cluster
+(``--transport amqp``).
+
+Sync discipline (SURVEY.md §7 hard-part 2): the optimizer loop never reads a
+device value per step — step/version counters are host-side mirrors, the
+donated train step is dispatch-only, and metrics are fetched (one transfer)
+only at ``log_every`` boundaries. On hardware where a host↔device round trip
+is expensive this is the difference between dispatch-rate and sync-rate
+training.
 
 Usage:
     python -m dotaclient_tpu.train.learner --smoke       # tiny sanity run
@@ -20,10 +30,12 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from dotaclient_tpu.buffer import TrajectoryBuffer
@@ -78,6 +90,10 @@ class Learner:
         self.metrics = MetricsLogger(logdir)
         self.frames_per_rollout = config.ppo.rollout_len
         self._last_metrics: Dict[str, float] = {}
+        # Host-side mirrors of state.step/state.version: reading the device
+        # scalars costs a full sync per read, so the loop never does.
+        self._host_step = int(np.asarray(self.state.step))
+        self._host_version = int(np.asarray(self.state.version))
 
     # -- loop --------------------------------------------------------------
 
@@ -88,39 +104,122 @@ class Learner:
         if not protos:
             return 0
         return self.buffer.add(
-            [decode_rollout(p) for p in protos], int(self.state.version)
+            [decode_rollout(p) for p in protos], self._host_version
         )
 
-    def train(self, num_steps: int, actor_steps_per_iter: Optional[int] = None) -> Dict[str, float]:
-        """Run until ``num_steps`` optimizer steps have completed."""
+    def _optimize(self, batch) -> Dict[str, jnp.ndarray]:
+        """Run ``epochs_per_batch`` optimizer passes over one batch
+        (dispatch-only; the reference's multi-epoch PPO pass). Returns the
+        last pass's (device-resident) metrics."""
+        for _ in range(self.config.ppo.epochs_per_batch):
+            self.state, m = self.train_step(self.state, batch)
+            self._host_step += 1
+            self._host_version += 1
+        return m
+
+    def _actor_params_copy(self):
+        """Device-to-device copy of the current params for the actor pool:
+        the train step donates the state, so actors must never hold the
+        learner's own buffers (they die on the next step)."""
+        return jax.tree.map(jnp.copy, self.state.params)
+
+    def train(
+        self,
+        num_steps: int,
+        actor_steps_per_iter: Optional[int] = None,
+        overlap: bool = False,
+        refresh_every: int = 1,
+    ) -> Dict[str, float]:
+        """Run until ``num_steps`` optimizer steps have completed.
+
+        ``overlap=False``: strictly alternating actor/learner phases
+        (deterministic; the test path). ``overlap=True``: the actor pool runs
+        in its own thread feeding the transport while this thread trains —
+        the staleness filter and version tags do real work here.
+        """
         cfg = self.config
+        epochs = cfg.ppo.epochs_per_batch
         actor_steps = actor_steps_per_iter or cfg.ppo.rollout_len
         t_start = time.time()
         frames_trained = 0
         steps_done = 0
-        while steps_done < num_steps:
-            # Actor phase: generate experience with the current weights.
-            self.pool.set_params(self.state.params, int(self.state.version))
-            self.pool.run(actor_steps, refresh_every=0)
-            self.ingest()
-            # Learner phase: drain full batches.
-            while (batch := self.buffer.take()) is not None:
-                self.state, m = self.train_step(self.state, batch)
-                steps_done += 1
-                frames_trained += cfg.ppo.batch_rollouts * cfg.ppo.rollout_len
-                step = int(self.state.step)
-                if step % cfg.log_every == 0:
-                    scalars = {k: float(np.asarray(v)) for k, v in m.items()}
-                    scalars.update(self.pool.stats())
-                    scalars.update(self.buffer.metrics())
-                    elapsed = time.time() - t_start
-                    scalars["frames_per_sec"] = frames_trained / max(elapsed, 1e-9)
-                    self._last_metrics = scalars
-                    self.metrics.log(step, scalars)
-                if self.ckpt and step % cfg.checkpoint_every == 0:
-                    self.ckpt.save(self.state, cfg)
-                if steps_done >= num_steps:
-                    break
+
+        def after_step(m) -> None:
+            nonlocal frames_trained
+            frames_trained += cfg.ppo.batch_rollouts * cfg.ppo.rollout_len
+            step = self._host_step
+            if step % cfg.log_every < epochs:
+                # ONE transfer for the whole metrics dict.
+                scalars = {
+                    k: float(v) for k, v in jax.device_get(m).items()
+                }
+                scalars.update(self.pool.stats())
+                scalars.update(self.buffer.metrics())
+                elapsed = time.time() - t_start
+                scalars["frames_per_sec"] = frames_trained / max(elapsed, 1e-9)
+                self._last_metrics = scalars
+                self.metrics.log(step, scalars)
+            # `< epochs` (not `== 0`): the counter advances in strides of
+            # epochs_per_batch, which may step over exact multiples.
+            if self.ckpt and step % cfg.checkpoint_every < epochs:
+                self.ckpt.save(self.state, cfg)
+
+        if overlap:
+            stop = threading.Event()
+            actor_error: List[BaseException] = []
+
+            def actor_loop() -> None:
+                try:
+                    while not stop.is_set():
+                        self.pool.step()
+                except BaseException as e:  # surface, never swallow
+                    actor_error.append(e)
+
+            self.pool.set_params(self._actor_params_copy(), self._host_version)
+            actor_thread = threading.Thread(
+                target=actor_loop, name="actor", daemon=True
+            )
+            actor_thread.start()
+            try:
+                while steps_done < num_steps:
+                    if actor_error:
+                        raise RuntimeError(
+                            "actor thread died; learner cannot make progress"
+                        ) from actor_error[0]
+                    self.ingest()
+                    batch = self.buffer.take(
+                        current_version=self._host_version
+                    )
+                    if batch is None:
+                        time.sleep(0.002)
+                        continue
+                    m = self._optimize(batch)
+                    steps_done += epochs
+                    after_step(m)
+                    if (steps_done // epochs) % refresh_every == 0:
+                        self.pool.set_params(
+                            self._actor_params_copy(), self._host_version
+                        )
+            finally:
+                stop.set()
+                actor_thread.join(timeout=30.0)
+        else:
+            while steps_done < num_steps:
+                # Actor phase: generate experience with the current weights.
+                self.pool.set_params(self.state.params, self._host_version)
+                self.pool.run(actor_steps, refresh_every=0)
+                self.ingest()
+                # Learner phase: drain full batches.
+                while (
+                    batch := self.buffer.take(
+                        current_version=self._host_version
+                    )
+                ) is not None:
+                    m = self._optimize(batch)
+                    steps_done += epochs
+                    after_step(m)
+                    if steps_done >= num_steps:
+                        break
         # Publish final weights for out-of-process actors (cluster parity).
         self.transport.publish_weights(
             encode_weights(
@@ -154,6 +253,10 @@ def main(argv=None) -> Dict[str, float]:
     p.add_argument("--opponent", type=str, default=None)
     p.add_argument("--team-size", type=int, default=None)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--overlap", action="store_true",
+        help="run the actor pool in a background thread (async actor-learner)",
+    )
     args = p.parse_args(argv)
 
     config = default_config()
@@ -189,7 +292,7 @@ def main(argv=None) -> Dict[str, float]:
         restore=args.restore,
         seed=args.seed,
     )
-    stats = learner.train(args.steps)
+    stats = learner.train(args.steps, overlap=args.overlap)
     print(
         f"done: {stats['optimizer_steps']:.0f} steps, "
         f"{stats['frames_trained']:.0f} frames, "
