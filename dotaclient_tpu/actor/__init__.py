@@ -1,5 +1,6 @@
-"""Batched actor runtime."""
+"""Batched actor runtime (scalar gRPC-parity pool + vectorized pool)."""
 
 from dotaclient_tpu.actor.runtime import ActorPool, build_game_config
+from dotaclient_tpu.actor.vec_runtime import VecActorPool, make_device_step
 
-__all__ = ["ActorPool", "build_game_config"]
+__all__ = ["ActorPool", "VecActorPool", "build_game_config", "make_device_step"]

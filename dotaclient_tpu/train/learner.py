@@ -32,15 +32,17 @@ import argparse
 import dataclasses
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from collections import deque
+
 from dotaclient_tpu.buffer import TrajectoryBuffer
 from dotaclient_tpu.config import RunConfig, default_config
-from dotaclient_tpu.actor import ActorPool
+from dotaclient_tpu.actor import ActorPool, VecActorPool
 from dotaclient_tpu.models import init_params, make_policy
 from dotaclient_tpu.parallel import make_mesh
 from dotaclient_tpu.train.ppo import init_train_state, make_train_step
@@ -65,6 +67,7 @@ class Learner:
         checkpoint_dir: Optional[str] = None,
         restore: bool = False,
         seed: int = 0,
+        vec: bool = True,
     ) -> None:
         self.config = config
         self.mesh = make_mesh(config.mesh)
@@ -79,14 +82,32 @@ class Learner:
         self.train_step = make_train_step(self.policy, config, self.mesh)
         self.buffer = TrajectoryBuffer(config, self.mesh)
         self.transport = transport or InProcTransport()
-        self.pool = ActorPool(
-            config,
-            self.policy,
-            self.state.params,
-            transport=self.transport,
-            seed=seed,
-            version=int(self.state.version),
+        # Vectorized mode ships decoded rollouts through an in-proc deque
+        # (thread-safe append/drain) — no proto round-trip on the hot path;
+        # the scalar pool keeps proto/gRPC parity coverage. Bounded with
+        # drop-oldest, like InProcTransport: in overlap mode the actor thread
+        # free-runs while the learner compiles/checkpoints.
+        self._sink: Optional[deque] = (
+            deque(maxlen=4 * config.buffer.capacity_rollouts) if vec else None
         )
+        if vec:
+            self.pool: Any = VecActorPool(
+                config,
+                self.policy,
+                self.state.params,
+                seed=seed,
+                version=int(self.state.version),
+                rollout_sink=self._sink.extend,
+            )
+        else:
+            self.pool = ActorPool(
+                config,
+                self.policy,
+                self.state.params,
+                transport=self.transport,
+                seed=seed,
+                version=int(self.state.version),
+            )
         self.metrics = MetricsLogger(logdir)
         self.frames_per_rollout = config.ppo.rollout_len
         self._last_metrics: Dict[str, float] = {}
@@ -98,6 +119,14 @@ class Learner:
     # -- loop --------------------------------------------------------------
 
     def ingest(self) -> int:
+        if self._sink is not None:
+            rollouts = []
+            cap = self.config.buffer.capacity_rollouts
+            while self._sink and len(rollouts) < cap:
+                rollouts.append(self._sink.popleft())
+            if not rollouts:
+                return 0
+            return self.buffer.add(rollouts, self._host_version)
         protos = self.transport.consume_rollouts(
             self.config.buffer.capacity_rollouts, timeout=0.001
         )
@@ -257,6 +286,11 @@ def main(argv=None) -> Dict[str, float]:
         "--overlap", action="store_true",
         help="run the actor pool in a background thread (async actor-learner)",
     )
+    p.add_argument(
+        "--no-vec", action="store_true",
+        help="use the scalar (proto/gRPC-parity) actor pool instead of the "
+        "vectorized sim",
+    )
     args = p.parse_args(argv)
 
     config = default_config()
@@ -291,6 +325,7 @@ def main(argv=None) -> Dict[str, float]:
         checkpoint_dir=args.checkpoint_dir,
         restore=args.restore,
         seed=args.seed,
+        vec=not args.no_vec,
     )
     stats = learner.train(args.steps, overlap=args.overlap)
     print(
