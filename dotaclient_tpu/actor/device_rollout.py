@@ -1,0 +1,317 @@
+"""On-device rollout generation: policy + env + reward in ONE XLA program.
+
+The third and fastest actor (after the scalar proto pool and the numpy
+vectorized pool): the jittable ``jax_lane_sim`` makes the entire experience
+loop a ``lax.scan`` — featurize → policy step → sample → env step → reward →
+in-scan episode reset — compiled once and run for a whole T-step chunk per
+dispatch. Per-chunk host traffic is ZERO on the experience path (chunks are
+consumed device-to-device by the trajectory buffer); only tiny episode stats
+are fetched, and only at log boundaries.
+
+This is the Anakin/Podracer architecture (PAPERS.md [P:7]) and the design
+answer to SURVEY.md §7 hard-part 2: on this sandbox's tunneled TPU a single
+host↔device round trip costs ~100 ms, which bounds any host-driven actor at
+~10 chunks/sec regardless of batch size; the on-device loop is bounded by
+compute instead.
+
+Chunks SPAN episodes (valid is all-ones; ``dones`` marks boundaries and the
+learner's sequence mode resets the carry mid-chunk — ``Policy.sequence``) so
+no frame is ever padding: fixed shapes, zero waste.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dotaclient_tpu.config import RunConfig
+from dotaclient_tpu.envs import jax_lane_sim as sim_mod
+from dotaclient_tpu.envs.vec_lane_sim import VecSimSpec, draft_games
+from dotaclient_tpu.features.jax_featurizer import (
+    JaxFeaturizer,
+    shaped_rewards,
+)
+from dotaclient_tpu.models import distributions as D
+from dotaclient_tpu.models.policy import Policy
+from dotaclient_tpu.protos import dota_pb2 as pb
+
+
+class DeviceActorState(NamedTuple):
+    """Everything the rollout loop carries across chunks, device-resident."""
+
+    sim: sim_mod.SimState
+    carry: Tuple[jnp.ndarray, jnp.ndarray]       # learner lanes' LSTM state
+    opp_carry: Tuple[jnp.ndarray, jnp.ndarray]   # opponent lanes' (or dummy)
+    key: jnp.ndarray
+    ep_return: jnp.ndarray                       # f32 [L] running episode return
+    # cumulative episode stats, accumulated IN the rollout program so a
+    # drain fetches 4 scalars however many chunks were collected
+    stats: Dict[str, jnp.ndarray]
+
+
+def build_spec(config: RunConfig) -> VecSimSpec:
+    env = config.env
+    return VecSimSpec(
+        n_games=env.n_envs,
+        team_size=env.team_size,
+        max_units=config.obs.max_units,
+        ticks_per_obs=env.ticks_per_observation,
+        max_dota_time=env.max_dota_time,
+        move_bins=config.actions.move_bins,
+    )
+
+
+def lane_split(config: RunConfig) -> Tuple[list, list]:
+    """(learner players, opponent players) per the opponent mode — identical
+    to ``VecActorPool``'s split."""
+    env = config.env
+    P = 2 * env.team_size
+    if env.opponent == "selfplay":
+        return list(range(P)), []
+    if env.opponent == "league":
+        return list(range(env.team_size)), list(range(env.team_size, P))
+    return list(range(env.team_size)), []
+
+
+class DeviceActor:
+    """Owns device-resident env+policy state; emits device chunk batches.
+
+    API parallel to the pools where it makes sense (``stats``,
+    ``set_params``/``set_opponent`` are the host-visible surface), but the
+    unit of work is ``collect(params)`` → one chunk batch [L, T, ...],
+    already on device, ready for ``TrajectoryBuffer.add_device``.
+    """
+
+    def __init__(self, config: RunConfig, policy: Policy, seed: int = 0) -> None:
+        self.config = config
+        self.policy = policy
+        self.spec = build_spec(config)
+        learner_players, opponent_players = lane_split(config)
+        self.learner_players = learner_players
+        self.opponent_players = opponent_players
+        self.feat = JaxFeaturizer(
+            self.spec, config.obs, config.actions, learner_players
+        )
+        self._opp_feat = (
+            JaxFeaturizer(self.spec, config.obs, config.actions, opponent_players)
+            if opponent_players
+            else None
+        )
+        self.n_lanes = self.feat.n_lanes
+
+        N, P = self.spec.n_games, self.spec.n_players
+        hero_ids, control = draft_games(
+            N, config.env.team_size, config.env.hero_pool,
+            config.env.opponent, seed,
+        )
+
+        key = jax.random.PRNGKey(seed)
+        key, k_init = jax.random.split(key)
+        sim0 = sim_mod.init_state(self.spec, hero_ids, control, k_init)
+        opp_lanes = max(len(opponent_players) * N, 1)
+        self.state = DeviceActorState(
+            sim=sim0,
+            carry=policy.initial_state(self.n_lanes),
+            opp_carry=policy.initial_state(opp_lanes),
+            key=key,
+            ep_return=jnp.zeros((self.n_lanes,), jnp.float32),
+            stats=self._zero_stats(),
+        )
+        # No donation: the state is small (the big arrays are the chunk
+        # OUTPUTS), and zero-initialized carries can alias the same cached
+        # constant buffer, which donation would flag as a double-donate.
+        self._rollout = jax.jit(self._rollout_impl)
+        # host-side counters, updated from fetched stats at log boundaries
+        self.env_steps = 0
+        self.rollouts_shipped = 0
+        self.episodes_done = 0
+        self.wins = 0
+        self._reward_sum = 0.0
+        self._ep_count_window = 0.0
+
+    @staticmethod
+    def _zero_stats() -> Dict[str, jnp.ndarray]:
+        z = jnp.zeros((), jnp.float32)
+        return {"episodes": z, "wins": z, "reward_sum": z, "ep_return_sum": z}
+
+    # -- the jitted chunk generator ---------------------------------------
+
+    def _rollout_impl(
+        self,
+        params: Any,
+        state: DeviceActorState,
+        opp_params: Any,
+    ):
+        cfg = self.config
+        spec = self.spec
+        T = cfg.ppo.rollout_len
+        A = len(self.learner_players)
+        feat = self.feat
+        owner_team = (
+            sim_mod.TEAM_RADIANT
+            if self.learner_players[0] < spec.team_size
+            else sim_mod.TEAM_DIRE
+        )
+
+        carry0 = (
+            state.carry[0].astype(jnp.float32),
+            state.carry[1].astype(jnp.float32),
+        )
+
+        def body(c, _):
+            sim, lstm, opp_lstm, key, ep_ret = c
+            key, k_act, k_opp = jax.random.split(key, 3)
+
+            obs = feat.featurize(sim)
+            logits, _, lstm2 = self.policy.apply(
+                params, obs, lstm, method="step"
+            )
+            acts, logp = D.sample(k_act, logits, obs)
+            packed = jnp.stack(
+                [acts[h] for h in D.HEADS], axis=1
+            ).astype(jnp.int32)
+            sim_acts = feat.actions_to_sim(packed)
+
+            if self._opp_feat is not None:
+                oobs = self._opp_feat.featurize(sim)
+                ologits, _, opp_lstm2 = self.policy.apply(
+                    opp_params, oobs, opp_lstm, method="step"
+                )
+                oacts, _ = D.sample(k_opp, ologits, oobs)
+                opacked = jnp.stack(
+                    [oacts[h] for h in D.HEADS], axis=1
+                ).astype(jnp.int32)
+                osim = self._opp_feat.actions_to_sim(opacked)
+                opp_mask = jnp.zeros((spec.n_players,), bool).at[
+                    jnp.asarray(self.opponent_players)
+                ].set(True)
+                sim_acts = {
+                    k: jnp.where(opp_mask[None, :], osim[k], sim_acts[k])
+                    for k in sim_acts
+                }
+            else:
+                opp_lstm2 = opp_lstm
+
+            sim2 = sim_mod.step(
+                spec, sim, sim_acts,
+                scripted_possible=self.config.env.opponent
+                not in ("selfplay", "league"),
+            )
+            r = shaped_rewards(spec, self.learner_players, sim, sim2)
+            done_g = sim2.done
+            win_g = done_g & (sim2.winning_team == owner_team)
+            ep_ret = ep_ret + r
+
+            sim3 = sim_mod.reset_where(spec, sim2, done_g)
+            done_lane = jnp.repeat(done_g, A)
+            keep = (~done_lane)[:, None].astype(lstm2[0].dtype)
+            lstm3 = (lstm2[0] * keep, lstm2[1] * keep)
+            if self._opp_feat is not None:
+                okeep = (~jnp.repeat(done_g, len(self.opponent_players)))[
+                    :, None
+                ].astype(opp_lstm2[0].dtype)
+                opp_lstm3 = (opp_lstm2[0] * okeep, opp_lstm2[1] * okeep)
+            else:
+                opp_lstm3 = opp_lstm2
+
+            # completed-episode returns leave through stats; the accumulator
+            # resets on done (owner lane per game, matching the pools)
+            owner_ret = ep_ret.reshape(-1, A)[:, 0]
+            out = {
+                "obs": obs,
+                "packed": packed,
+                "logp": logp,
+                "reward": r,
+                "done_lane": done_lane.astype(jnp.float32),
+                "ep_done": done_g,
+                "win": win_g,
+                "ep_return": jnp.where(done_g, owner_ret, 0.0),
+            }
+            ep_ret = jnp.where(done_lane, 0.0, ep_ret)
+            return (sim3, lstm3, opp_lstm3, key, ep_ret), out
+
+        (sim_f, lstm_f, opp_f, key_f, ep_ret_f), outs = jax.lax.scan(
+            body,
+            (state.sim, state.carry, state.opp_carry, state.key, state.ep_return),
+            None,
+            length=T,
+        )
+
+        bootstrap = feat.featurize(sim_f)                        # [L, ...]
+
+        def to_chunk_obs(seq, boot):
+            # [T, L, ...] -> [L, T+1, ...]
+            seq = jnp.moveaxis(seq, 0, 1)
+            return jnp.concatenate([seq, boot[:, None]], axis=1)
+
+        obs_seq = jax.tree.map(to_chunk_obs, outs["obs"], bootstrap)
+        packed = jnp.moveaxis(outs["packed"], 0, 1)              # [L, T, 5]
+        chunk = {
+            "obs": obs_seq,
+            "actions": {
+                h: packed[:, :, j] for j, h in enumerate(D.HEADS)
+            },
+            "behavior_logp": jnp.moveaxis(outs["logp"], 0, 1),
+            "rewards": jnp.moveaxis(outs["reward"], 0, 1),
+            "dones": jnp.moveaxis(outs["done_lane"], 0, 1),
+            "valid": jnp.ones((self.n_lanes, T), jnp.float32),
+            "carry0": carry0,
+        }
+        stats = {
+            "episodes": outs["ep_done"].sum().astype(jnp.float32),
+            "wins": outs["win"].sum().astype(jnp.float32),
+            "reward_sum": outs["reward"].sum(),
+            "ep_return_sum": outs["ep_return"].sum(),
+        }
+        cum_stats = {k: state.stats[k] + stats[k] for k in stats}
+        new_state = DeviceActorState(
+            sim=sim_f, carry=lstm_f, opp_carry=opp_f, key=key_f,
+            ep_return=ep_ret_f, stats=cum_stats,
+        )
+        return new_state, chunk, stats
+
+    # -- host surface ------------------------------------------------------
+
+    def collect(self, params: Any, opp_params: Any = None):
+        """Generate one chunk batch [L, T, ...] (device arrays). Returns
+        (chunk, device stats dict) — dispatch-only, no host sync."""
+        if opp_params is None:
+            opp_params = params
+        self.state, chunk, stats = self._rollout(params, self.state, opp_params)
+        T = self.config.ppo.rollout_len
+        self.env_steps += self.n_lanes * T
+        self.rollouts_shipped += self.n_lanes
+        return chunk, stats
+
+    def drain_stats(self) -> Dict[str, float]:
+        """Fetch the device-accumulated episode stats (4 scalars, ONE host
+        sync regardless of how many chunks were collected); call at log
+        boundaries only."""
+        s = jax.device_get(self.state.stats)
+        self.state = self.state._replace(stats=self._zero_stats())
+        self.episodes_done += int(s["episodes"])
+        self.wins += int(s["wins"])
+        self._reward_sum += float(s["ep_return_sum"])
+        self._ep_count_window += float(s["episodes"])
+        return self.stats()
+
+    def stats(self) -> Dict[str, float]:
+        # mean return over COMPLETED episodes (owner-lane convention,
+        # matching the host pools' episode_reward_mean)
+        mean_ep = (
+            self._reward_sum / self._ep_count_window
+            if self._ep_count_window
+            else 0.0
+        )
+        return {
+            "env_steps": float(self.env_steps),
+            "rollouts_shipped": float(self.rollouts_shipped),
+            "episodes_done": float(self.episodes_done),
+            "episode_reward_mean": mean_ep,
+            "win_rate": (
+                self.wins / self.episodes_done if self.episodes_done else 0.0
+            ),
+        }

@@ -24,7 +24,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from dotaclient_tpu.config import RunConfig
-from dotaclient_tpu.envs.vec_lane_sim import VecLaneSim, VecSimSpec
+from dotaclient_tpu.envs.vec_lane_sim import (
+    OPPONENT_CONTROL,
+    VecLaneSim,
+    VecSimSpec,
+    draft_games,
+)
 from dotaclient_tpu.features.vec_featurizer import VecFeaturizer, VecRewards
 from dotaclient_tpu.models import distributions as D
 from dotaclient_tpu.models.policy import Policy
@@ -86,17 +91,10 @@ class VecActorPool:
             max_dota_time=env.max_dota_time,
             move_bins=config.actions.move_bins,
         )
-        rng = np.random.default_rng(seed)
-        pool = np.asarray(env.hero_pool or (1,), np.int32)
-        hero_ids = rng.choice(pool, size=(N, P))
-        opp_mode = {
-            "scripted_easy": pb.CONTROL_SCRIPTED_EASY,
-            "scripted_hard": pb.CONTROL_SCRIPTED_HARD,
-            "selfplay": pb.CONTROL_AGENT,
-            "league": pb.CONTROL_AGENT,
-        }[env.opponent]
-        control = np.full((N, P), pb.CONTROL_AGENT, np.int32)
-        control[:, env.team_size:] = opp_mode
+        hero_ids, control = draft_games(
+            N, env.team_size, env.hero_pool, env.opponent, seed
+        )
+        opp_mode = OPPONENT_CONTROL[env.opponent]
         self.sim = VecLaneSim(spec, hero_ids, control, seed=seed)
         self._reseed_rng = np.random.default_rng(seed ^ 0x5EED)
 

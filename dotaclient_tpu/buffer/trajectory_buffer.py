@@ -157,6 +157,40 @@ class TrajectoryBuffer:
         self.ingested += len(fresh)
         return len(fresh)
 
+    def add_device(self, chunk: Dict[str, Any], version: int) -> int:
+        """Ingest a device-resident chunk batch (arrays ``[L, T, ...]``, the
+        on-device rollout path) — device-to-device scatter, no host copy of
+        the experience tensors.
+
+        Freshness: these chunks are produced with the current params by
+        construction, so no staleness filter runs here; the slots are still
+        version-tagged for consume-time re-checks.
+        """
+        L = chunk["valid"].shape[0]
+        take = min(L, self.capacity)
+        if take < L:
+            self.dropped_overflow += L - take
+        slots = []
+        for _ in range(take):
+            if self._free:
+                slots.append(self._free.pop())
+            else:
+                slots.append(self._order.popleft())
+                self.dropped_overflow += 1
+        idx = np.asarray(slots, dtype=np.int32)
+        pos = 0
+        remaining = take
+        while remaining:
+            n = 1 << (remaining.bit_length() - 1)
+            rows = jax.tree.map(lambda r: r[pos:pos + n], chunk)
+            self._store = self._scatter(self._store, rows, idx[pos:pos + n])
+            pos += n
+            remaining -= n
+        self._slot_version[idx] = version
+        self._order.extend(slots)
+        self.ingested += take
+        return take
+
     # -- consume -----------------------------------------------------------
 
     def take(

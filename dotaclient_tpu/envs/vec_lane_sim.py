@@ -89,6 +89,33 @@ def _armor_mult(armor: np.ndarray) -> np.ndarray:
     return 1.0 - (0.06 * armor) / (1.0 + 0.06 * armor)
 
 
+OPPONENT_CONTROL = {
+    "scripted_easy": pb.CONTROL_SCRIPTED_EASY,
+    "scripted_hard": pb.CONTROL_SCRIPTED_HARD,
+    "selfplay": pb.CONTROL_AGENT,
+    "league": pb.CONTROL_AGENT,
+}
+
+
+def draft_games(
+    n_games: int,
+    team_size: int,
+    hero_pool: Sequence[int],
+    opponent: str,
+    seed: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Hero picks + control modes for a batch of games: (hero_ids [N, P],
+    control_modes [N, P]). Radiant players are always agent-controlled; Dire
+    control follows ``opponent``. Shared by every vectorized actor."""
+    P = 2 * team_size
+    rng = np.random.default_rng(seed)
+    pool = np.asarray(hero_pool or (1,), np.int32)
+    hero_ids = rng.choice(pool, size=(n_games, P)).astype(np.int32)
+    control = np.full((n_games, P), pb.CONTROL_AGENT, np.int32)
+    control[:, team_size:] = OPPONENT_CONTROL[opponent]
+    return hero_ids, control
+
+
 @dataclasses.dataclass(frozen=True)
 class VecSimSpec:
     """Static layout of a vectorized sim batch."""

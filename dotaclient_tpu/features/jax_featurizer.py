@@ -1,0 +1,289 @@
+"""Featurization, rewards, and action translation over ``jax_lane_sim``
+states — pure jnp functions, composable into the on-device rollout scan.
+
+Port of ``features.vec_featurizer`` (same observation contract, same static
+per-lane slot permutation, same reward WEIGHTS); parity with the numpy path
+is tested in ``tests/test_jax_sim.py``. Everything here traces into the one
+XLA program that ``actor.device_rollout`` builds (SURVEY.md §7 hard-part 2).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from dotaclient_tpu.config import ActionSpec, ObsSpec
+from dotaclient_tpu.envs.jax_lane_sim import SimState, hero_castable
+from dotaclient_tpu.envs.lane_sim import NUKE_RANGE, TEAM_RADIANT
+from dotaclient_tpu.envs.vec_lane_sim import VecSimSpec
+from dotaclient_tpu.features import featurizer as F
+from dotaclient_tpu.features.reward import WEIGHTS
+from dotaclient_tpu.protos import dota_pb2 as pb
+
+
+def build_perm(spec: VecSimSpec, agent_players: Sequence[int]) -> np.ndarray:
+    """Static per-lane unit ordering [A, S]: self, other heroes, creeps,
+    towers (identical to ``VecFeaturizer``'s)."""
+    S, P = spec.max_units, spec.n_players
+    creeps = np.arange(spec.creep_lo, S)
+    towers = np.arange(spec.tower_lo, spec.creep_lo)
+    perm = np.zeros((len(agent_players), S), np.int64)
+    for a, p in enumerate(agent_players):
+        others = [q for q in range(P) if q != p]
+        perm[a] = np.concatenate([[p], others, creeps, towers])
+    return perm
+
+
+class JaxFeaturizer:
+    """Pure featurize/translate functions bound to a static lane layout."""
+
+    def __init__(
+        self,
+        spec: VecSimSpec,
+        obs_spec: ObsSpec,
+        action_spec: ActionSpec,
+        agent_players: Sequence[int],
+    ) -> None:
+        if obs_spec.max_units != spec.max_units:
+            raise ValueError("ObsSpec.max_units must equal sim slot count")
+        if action_spec.max_units != spec.max_units:
+            raise ValueError("ActionSpec.max_units must equal sim slot count")
+        self.spec = spec
+        self.obs_spec = obs_spec
+        self.action_spec = action_spec
+        self.agent_players = tuple(int(p) for p in agent_players)
+        self._ap = jnp.asarray(self.agent_players, jnp.int32)
+        self.perm = build_perm(spec, agent_players)            # np [A, S]
+        self._perm_j = jnp.asarray(self.perm)
+        self.n_lanes = spec.n_games * len(self.agent_players)
+
+    # -- observations ------------------------------------------------------
+
+    def featurize(self, state: SimState) -> Dict[str, jnp.ndarray]:
+        """All lanes' observations; arrays with leading axis L = N*A."""
+        spec = self.spec
+        N, S, P = spec.n_games, spec.max_units, spec.n_players
+        A = len(self.agent_players)
+        ap = self._ap
+        perm = self._perm_j
+
+        def g(arr):
+            return arr[:, perm]                                # [N, A, S]
+
+        unit_type = g(state.unit_type)
+        team = g(state.team)
+        alive = g(state.alive)
+        x, y = g(state.x), g(state.y)
+        health, health_max = g(state.health), g(state.health_max)
+        mana, mana_max = g(state.mana), g(state.mana_max)
+        castable = g(hero_castable(state))
+
+        my_team = state.team[:, ap][:, :, None]
+        me_x = state.x[:, ap][:, :, None]
+        me_y = state.y[:, ap][:, :, None]
+        me_alive = state.alive[:, ap]
+
+        present = (unit_type != 0) & (alive | (unit_type == pb.UNIT_HERO))
+        is_hero = unit_type == pb.UNIT_HERO
+        is_creep = unit_type == pb.UNIT_LANE_CREEP
+        is_tower = unit_type == pb.UNIT_TOWER
+        is_ally = (team == my_team) & present
+        is_self = jnp.zeros((N, A, S), bool).at[:, :, 0].set(present[:, :, 0])
+        dx = (x - me_x) / F._POS_SCALE
+        dy = (y - me_y) / F._POS_SCALE
+        dist = jnp.hypot(x - me_x, y - me_y)
+        deniable = is_ally & ~is_self & is_creep & (health < 0.5 * health_max)
+
+        cols = (
+            is_hero, is_creep, is_tower, is_ally, present & ~is_ally, is_self,
+            x / F._POS_SCALE, y / F._POS_SCALE, dx, dy, dist / F._POS_SCALE,
+            health / jnp.maximum(health_max, 1.0), health_max / F._HP_SCALE,
+            mana / jnp.maximum(mana_max, 1.0),
+            g(state.damage) / F._DMG_SCALE,
+            g(state.attack_range) / F._RANGE_SCALE,
+            g(state.move_speed) / F._SPEED_SCALE,
+            g(state.armor) / F._ARMOR_SCALE,
+            g(state.level) / F._LEVEL_SCALE, alive, castable, deniable,
+        )
+        f = jnp.stack([c.astype(jnp.float32) for c in cols], axis=-1)
+        f = f * present[..., None]
+
+        self_castable = castable[:, :, 0]
+        cast_range = jnp.where(self_castable, NUKE_RANGE, 0.0)[:, :, None]
+        is_enemy = present & (team != my_team)
+        attackable = (
+            present & alive & (is_enemy | deniable) & ~is_self
+            & me_alive[:, :, None]
+        )
+        cast_tgt = is_enemy & alive & (dist <= cast_range) & me_alive[:, :, None]
+
+        mask_action = (
+            jnp.zeros((N, A, self.action_spec.n_action_types), bool)
+            .at[..., pb.ACTION_NOOP].set(True)
+            .at[..., pb.ACTION_MOVE].set(me_alive)
+            .at[..., pb.ACTION_ATTACK_UNIT].set(attackable.any(-1))
+            .at[..., pb.ACTION_CAST].set(self_castable & cast_tgt.any(-1))
+        )
+        mask_ability = (
+            jnp.zeros((N, A, self.action_spec.max_abilities), bool)
+            .at[..., 0].set(mask_action[..., pb.ACTION_CAST])
+        )
+
+        tower_r, tower_d = self.spec.tower_lo, self.spec.tower_lo + 1
+        tower_hp = jnp.stack(
+            [
+                state.health[:, tower_r] / jnp.maximum(state.health_max[:, tower_r], 1.0),
+                state.health[:, tower_d] / jnp.maximum(state.health_max[:, tower_d], 1.0),
+            ],
+            axis=1,
+        )
+        team_row = state.team[:, :P]
+        kills_rad = (state.kills[:, :P] * (team_row == TEAM_RADIANT)).sum(1)
+        kills_dire = (state.kills[:, :P] * (team_row != TEAM_RADIANT)).sum(1)
+        i_rad = my_team[:, :, 0] == TEAM_RADIANT
+        kill_diff = jnp.where(
+            i_rad, (kills_rad - kills_dire)[:, None], (kills_dire - kills_rad)[:, None]
+        ).astype(jnp.float32)
+        own_tower = jnp.where(i_rad, tower_hp[:, 0:1], tower_hp[:, 1:2])
+        enemy_tower = jnp.where(i_rad, tower_hp[:, 1:2], tower_hp[:, 0:1])
+
+        gl = jnp.stack(
+            [
+                jnp.broadcast_to(
+                    (state.dota_time / F._TIME_SCALE)[:, None], (N, A)
+                ),
+                jnp.where(i_rad, 1.0, -1.0),
+                state.gold[:, ap] / F._GOLD_SCALE,
+                state.xp[:, ap] / F._XP_SCALE,
+                state.level[:, ap] / F._LEVEL_SCALE,
+                kill_diff / 10.0,
+                own_tower,
+                enemy_tower,
+            ],
+            axis=-1,
+        ).astype(jnp.float32)
+        pad = self.obs_spec.global_features - gl.shape[-1]
+        if pad:
+            gl = jnp.concatenate([gl, jnp.zeros((N, A, pad), jnp.float32)], -1)
+
+        L = N * A
+
+        def flat(arr):
+            return arr.reshape((L,) + arr.shape[2:])
+
+        return {
+            "units": flat(f),
+            "unit_mask": flat(present),
+            "unit_handles": jnp.broadcast_to(
+                (perm + 1).astype(jnp.int32)[None], (N, A, S)
+            ).reshape(L, S),
+            "globals": flat(gl),
+            "hero_id": state.hero_ids[:, ap].reshape(-1).astype(jnp.int32),
+            "mask_action_type": flat(mask_action),
+            "mask_target_unit": flat(attackable),
+            "mask_cast_target": flat(cast_tgt),
+            "mask_ability": flat(mask_ability),
+        }
+
+    # -- action translation ------------------------------------------------
+
+    def actions_to_sim(self, packed: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+        """Policy head indices [L, 5] → sim action arrays [N, P]; non-agent
+        players get type = -1 (scripted players are overridden in-sim)."""
+        spec = self.spec
+        N, P, S = spec.n_games, spec.n_players, spec.max_units
+        A = len(self.agent_players)
+        packed = packed.reshape(N, A, 5)
+        ap = self._ap
+
+        obs_slot = jnp.clip(packed[..., 3], 0, S - 1)
+        sim_slot = jnp.take_along_axis(
+            jnp.broadcast_to(self._perm_j[None], (N, A, S)).astype(jnp.int32),
+            obs_slot[..., None].astype(jnp.int32), axis=2,
+        )[..., 0]
+
+        def scatter(col):
+            return jnp.full((N, P), -1, jnp.int32).at[:, ap].set(col)
+
+        return {
+            "type": scatter(packed[..., 0]),
+            "move_x": jnp.zeros((N, P), jnp.int32).at[:, ap].set(packed[..., 1]),
+            "move_y": jnp.zeros((N, P), jnp.int32).at[:, ap].set(packed[..., 2]),
+            "target_slot": jnp.zeros((N, P), jnp.int32).at[:, ap].set(sim_slot),
+            "ability": jnp.zeros((N, P), jnp.int32).at[:, ap].set(packed[..., 4]),
+        }
+
+
+def shaped_rewards(
+    spec: VecSimSpec,
+    agent_players: Sequence[int],
+    prev: SimState,
+    cur: SimState,
+) -> jnp.ndarray:
+    """Per-lane shaped reward [L] for the prev→cur interval (jnp port of
+    ``VecRewards``; same WEIGHTS and components as ``features.reward``)."""
+    P = spec.n_players
+    ap = jnp.asarray(tuple(int(p) for p in agent_players), jnp.int32)
+
+    def hero_hp_frac(s: SimState) -> jnp.ndarray:
+        return jnp.where(
+            s.alive[:, :P],
+            s.health[:, :P] / jnp.maximum(s.health_max[:, :P], 1.0),
+            0.0,
+        )
+
+    def tower_frac(s: SimState) -> jnp.ndarray:
+        tr, td = spec.tower_lo, spec.tower_lo + 1
+        frac = jnp.stack(
+            [
+                s.health[:, tr] / jnp.maximum(s.health_max[:, tr], 1.0),
+                s.health[:, td] / jnp.maximum(s.health_max[:, td], 1.0),
+            ],
+            axis=1,
+        )
+        alive = jnp.stack([s.alive[:, tr], s.alive[:, td]], axis=1)
+        return jnp.where(alive, frac, 0.0)
+
+    team_row = cur.team[:, :P]
+    rad_mask = team_row == TEAM_RADIANT
+
+    def team_mean_hp(s: SimState) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        hp = hero_hp_frac(s)
+        cnt_r = jnp.maximum(rad_mask.sum(1), 1)
+        cnt_d = jnp.maximum((~rad_mask).sum(1), 1)
+        return (hp * rad_mask).sum(1) / cnt_r, (hp * ~rad_mask).sum(1) / cnt_d
+
+    mean_r0, mean_d0 = team_mean_hp(prev)
+    mean_r1, mean_d1 = team_mean_hp(cur)
+    tower0, tower1 = tower_frac(prev), tower_frac(cur)
+
+    my_team = cur.team[:, ap]
+    i_rad = my_team == TEAM_RADIANT
+    e_hp0 = jnp.where(i_rad, mean_d0[:, None], mean_r0[:, None])
+    e_hp1 = jnp.where(i_rad, mean_d1[:, None], mean_r1[:, None])
+    e_tw0 = jnp.where(i_rad, tower0[:, 1:2], tower0[:, 0:1])
+    e_tw1 = jnp.where(i_rad, tower1[:, 1:2], tower1[:, 0:1])
+
+    def d(field):
+        return getattr(cur, field)[:, ap] - getattr(prev, field)[:, ap]
+
+    hp0 = hero_hp_frac(prev)[:, ap]
+    hp1 = hero_hp_frac(cur)[:, ap]
+
+    r = (
+        WEIGHTS["xp"] * d("xp")
+        + WEIGHTS["gold"] * d("gold")
+        + WEIGHTS["hp"] * (hp1 - hp0)
+        + WEIGHTS["enemy_hp"] * -(e_hp1 - e_hp0)
+        + WEIGHTS["last_hits"] * d("last_hits")
+        + WEIGHTS["denies"] * d("denies")
+        + WEIGHTS["kills"] * d("kills")
+        + WEIGHTS["deaths"] * d("deaths")
+        + WEIGHTS["tower_damage"] * (e_tw0 - e_tw1)
+    )
+    just_ended = cur.done & ~prev.done & (cur.winning_team != 0)
+    win_sign = jnp.where(cur.winning_team[:, None] == my_team, 1.0, -1.0)
+    r = r + WEIGHTS["win"] * win_sign * just_ended[:, None]
+    return r.reshape(-1).astype(jnp.float32)

@@ -148,14 +148,39 @@ class Policy(nn.Module):
         return logits, value, carry
 
     def sequence(
-        self, obs: Mapping[str, jnp.ndarray], carry: Carry
+        self,
+        obs: Mapping[str, jnp.ndarray],
+        carry: Carry,
+        dones: jnp.ndarray | None = None,
     ) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray, Carry]:
         """Teacher-forced sequence mode (learner path): obs arrays
         ``[B, T, ...]``, ``carry`` is the stored rollout-initial LSTM state.
-        Truncated-BPTT parity with the reference (SURVEY.md §5.7)."""
-        x, unit_emb = self._trunk(obs)                            # [B, T, H]
+        Truncated-BPTT parity with the reference (SURVEY.md §5.7).
 
-        def scan_step(cell, c, xt):
+        ``dones`` (``[B, T']`` with T' ≤ T, f32/bool, episode ended AT step t)
+        enables chunks that *span* episodes (the on-device rollout regime):
+        the recurrent state is zeroed before step t+1 whenever step t ended an
+        episode — exactly matching the actor-side reset — so step t+1 starts
+        its new episode from a fresh carry. Without ``dones`` the behavior is
+        unchanged (scalar-pool chunks never span episodes)."""
+        x, unit_emb = self._trunk(obs)                            # [B, T, H]
+        T = x.shape[1]
+        if dones is None:
+            resets = jnp.zeros((x.shape[0], T), x.dtype)
+        else:
+            # step 0 is reset by carry0 itself; step t>0 resets if t-1 done
+            resets = jnp.concatenate(
+                [
+                    jnp.zeros((x.shape[0], 1), x.dtype),
+                    dones.astype(x.dtype)[:, : T - 1],
+                ],
+                axis=1,
+            )
+
+        def scan_step(cell, c, inp):
+            xt, reset_t = inp
+            keep = (1.0 - reset_t)[:, None].astype(c[0].dtype)
+            c = (c[0] * keep, c[1] * keep)
             return cell(c, xt)
 
         scan = nn.scan(
@@ -165,7 +190,7 @@ class Policy(nn.Module):
             in_axes=1,
             out_axes=1,
         )
-        carry, ys = scan(self.core, carry, x)                     # ys [B, T, H]
+        carry, ys = scan(self.core, carry, (x, resets))           # ys [B, T, H]
         logits, value = self._heads(ys, unit_emb)
         return logits, value, carry
 

@@ -68,7 +68,15 @@ class Learner:
         restore: bool = False,
         seed: int = 0,
         vec: bool = True,
+        actor: Optional[str] = None,
     ) -> None:
+        # actor mode: "device" (on-device rollout scan — fastest, default for
+        # training runs), "vec" (numpy vectorized sim, host-driven), "scalar"
+        # (proto/gRPC-parity pool). `vec` kept for backward compatibility.
+        mode = actor or ("vec" if vec else "scalar")
+        if mode not in ("device", "vec", "scalar"):
+            raise ValueError(f"unknown actor mode {mode!r}")
+        self.actor_mode = mode
         self.config = config
         self.mesh = make_mesh(config.mesh)
         self.policy = make_policy(config.model, config.obs, config.actions)
@@ -88,10 +96,17 @@ class Learner:
         # drop-oldest, like InProcTransport: in overlap mode the actor thread
         # free-runs while the learner compiles/checkpoints.
         self._sink: Optional[deque] = (
-            deque(maxlen=4 * config.buffer.capacity_rollouts) if vec else None
+            deque(maxlen=4 * config.buffer.capacity_rollouts)
+            if mode == "vec" else None
         )
-        if vec:
-            self.pool: Any = VecActorPool(
+        self.device_actor = None
+        if mode == "device":
+            from dotaclient_tpu.actor.device_rollout import DeviceActor
+
+            self.device_actor = DeviceActor(config, self.policy, seed=seed)
+            self.pool: Any = self.device_actor  # shared stats() surface
+        elif mode == "vec":
+            self.pool = VecActorPool(
                 config,
                 self.policy,
                 self.state.params,
@@ -182,7 +197,11 @@ class Learner:
                 scalars = {
                     k: float(v) for k, v in jax.device_get(m).items()
                 }
-                scalars.update(self.pool.stats())
+                scalars.update(
+                    self.device_actor.drain_stats()
+                    if self.device_actor is not None
+                    else self.pool.stats()
+                )
                 scalars.update(self.buffer.metrics())
                 elapsed = time.time() - t_start
                 scalars["frames_per_sec"] = frames_trained / max(elapsed, 1e-9)
@@ -193,7 +212,25 @@ class Learner:
             if self.ckpt and step % cfg.checkpoint_every < epochs:
                 self.ckpt.save(self.state, cfg)
 
-        if overlap:
+        if self.device_actor is not None:
+            # On-device rollout mode: collect→ingest→train is all dispatch
+            # (the device serializes rollout and train programs back-to-back,
+            # so a host thread would add nothing; `overlap` is a no-op here).
+            da = self.device_actor
+            while steps_done < num_steps:
+                chunk, _ = da.collect(self.state.params)
+                self.buffer.add_device(chunk, self._host_version)
+                while (
+                    batch := self.buffer.take(
+                        current_version=self._host_version
+                    )
+                ) is not None:
+                    m = self._optimize(batch)
+                    steps_done += epochs
+                    after_step(m)
+                    if steps_done >= num_steps:
+                        break
+        elif overlap:
             stop = threading.Event()
             actor_error: List[BaseException] = []
 
@@ -249,6 +286,8 @@ class Learner:
                     after_step(m)
                     if steps_done >= num_steps:
                         break
+        if self.device_actor is not None:
+            self.device_actor.drain_stats()
         # Publish final weights for out-of-process actors (cluster parity).
         self.transport.publish_weights(
             encode_weights(
@@ -291,6 +330,12 @@ def main(argv=None) -> Dict[str, float]:
         help="use the scalar (proto/gRPC-parity) actor pool instead of the "
         "vectorized sim",
     )
+    p.add_argument(
+        "--actor", type=str, default=None,
+        choices=("device", "vec", "scalar"),
+        help="actor implementation: on-device rollout scan (default), "
+        "numpy vectorized sim, or scalar proto pool",
+    )
     args = p.parse_args(argv)
 
     config = default_config()
@@ -325,7 +370,7 @@ def main(argv=None) -> Dict[str, float]:
         checkpoint_dir=args.checkpoint_dir,
         restore=args.restore,
         seed=args.seed,
-        vec=not args.no_vec,
+        actor=args.actor or ("scalar" if args.no_vec else "device"),
     )
     stats = learner.train(args.steps, overlap=args.overlap)
     print(

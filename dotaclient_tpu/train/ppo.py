@@ -87,7 +87,7 @@ def ppo_loss(
     n_valid = jnp.maximum(valid.sum(), 1.0)
 
     logits, values, _ = policy.apply(
-        params, obs, batch["carry0"], method="sequence"
+        params, obs, batch["carry0"], batch["dones"], method="sequence"
     )
     # Trailing slot is the bootstrap step: value used, policy outputs unused.
     logits_t = {k: v[:, :T] for k, v in logits.items()}

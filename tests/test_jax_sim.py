@@ -1,0 +1,361 @@
+"""JAX sim / featurizer / on-device rollout tests.
+
+The numpy ``vec_lane_sim`` is the semantic oracle: the JAX sim is a
+phase-for-phase port, so over wave-free horizons (no RNG involved) the two
+must agree EXACTLY, scripted bots included. The device rollout path is tested
+against the training contract (chunk shapes, train-step consumption, the
+mid-chunk done/carry-reset semantics of ``Policy.sequence``).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dotaclient_tpu.config import default_config
+from dotaclient_tpu.envs import jax_lane_sim as J
+from dotaclient_tpu.envs import lane_sim
+from dotaclient_tpu.envs.vec_lane_sim import VecLaneSim, VecSimSpec
+from dotaclient_tpu.protos import dota_pb2 as pb
+
+
+def make_pair(n=4, team_size=1, p0=pb.CONTROL_SCRIPTED_EASY,
+              p1=pb.CONTROL_SCRIPTED_HARD, seed=0, **kw):
+    """A numpy vec sim and a JAX state initialized to the SAME world."""
+    spec = VecSimSpec(n_games=n, team_size=team_size, max_units=32, **kw)
+    P = spec.n_players
+    hero = np.ones((n, P), np.int32)
+    ctrl = np.full((n, P), pb.CONTROL_AGENT, np.int32)
+    ctrl[:, 0] = p0
+    ctrl[:, team_size] = p1
+    vsim = VecLaneSim(spec, hero, ctrl, seed=seed)
+    jstate = state_from_vec(vsim)
+    return spec, vsim, jstate
+
+
+def state_from_vec(vsim: VecLaneSim) -> J.SimState:
+    # jnp.array COPIES — jnp.asarray can zero-copy-alias the numpy buffers
+    # on CPU, which the vec sim then mutates in place (async-read corruption)
+    return J.SimState(
+        key=jax.random.PRNGKey(0),
+        **{
+            k: jnp.array(getattr(vsim, "_next_wave_at" if k == "next_wave_at" else k))
+            for k in J.SimState._fields
+            if k not in ("key", "tick")
+        },
+        tick=jnp.array(vsim.tick.astype(np.int32)),
+    )
+
+
+def noop(n, P):
+    a = {
+        k: np.zeros((n, P), np.int32)
+        for k in ("type", "move_x", "move_y", "target_slot", "ability")
+    }
+    a["type"][:] = -1
+    return a
+
+
+STATE_FIELDS = (
+    "x", "y", "health", "health_max", "mana", "gold", "xp", "level",
+    "alive", "kills", "deaths", "last_hits", "denies", "attack_cd",
+    "ability_cd", "done", "winning_team",
+)
+
+
+def assert_states_equal(vsim, jstate, context=""):
+    for name in STATE_FIELDS:
+        a = np.asarray(getattr(vsim, name), np.float64)
+        b = np.asarray(getattr(jstate, name), np.float64)
+        np.testing.assert_allclose(
+            a, b, rtol=1e-4, atol=1e-3, err_msg=f"{context}: field {name}"
+        )
+
+
+class TestJaxSimParity:
+    def test_exact_parity_scripted_wave_free(self):
+        """140 steps (28 s < first wave respawn at 30 s): zero randomness, so
+        the JAX port must track the numpy sim exactly — scripted bots, combat,
+        last-hits, XP, deaths, towers, the lot."""
+        spec, vsim, jstate = make_pair(n=4)
+        step = jax.jit(lambda s, a: J.step(spec, s, a))
+        acts = noop(4, 2)
+        jacts = {k: jnp.asarray(v) for k, v in acts.items()}
+        for t in range(140):
+            vsim.step(acts)
+            jstate = step(jstate, jacts)
+        assert_states_equal(vsim, jstate, "t=140")
+
+    def test_exact_parity_agent_actions(self):
+        """Driven hero actions (attack / cast / move) resolve identically."""
+        spec, vsim, jstate = make_pair(n=2, p0=pb.CONTROL_AGENT)
+        step = jax.jit(lambda s, a: J.step(spec, s, a))
+        rng = np.random.default_rng(0)
+        for t in range(60):
+            acts = noop(2, 2)
+            # random-ish but legal-ish agent actions for player 0
+            acts["type"][:, 0] = rng.integers(0, 4, size=2)
+            acts["move_x"][:, 0] = rng.integers(0, 9, size=2)
+            acts["move_y"][:, 0] = rng.integers(0, 9, size=2)
+            acts["target_slot"][:, 0] = rng.integers(0, 32, size=2)
+            acts["ability"][:, 0] = 0
+            vsim.step(acts)
+            jstate = step(jstate, {k: jnp.asarray(v) for k, v in acts.items()})
+        assert_states_equal(vsim, jstate, "agent-driven t=60")
+
+    def test_full_episode_statistics(self):
+        """Across full episodes (waves spawn → RNG differs) the port must
+        still produce the same game: hard beats easy, games end."""
+        spec = VecSimSpec(n_games=16, team_size=1, max_units=32, max_dota_time=300.0)
+        hero = np.ones((16, 2), np.int32)
+        ctrl = np.stack(
+            [np.full(16, pb.CONTROL_SCRIPTED_EASY),
+             np.full(16, pb.CONTROL_SCRIPTED_HARD)], 1
+        )
+        state = J.init_state(spec, jnp.asarray(hero), jnp.asarray(ctrl),
+                             jax.random.PRNGKey(0))
+        step = jax.jit(lambda s, a: J.step(spec, s, a))
+        a = {k: jnp.asarray(v) for k, v in noop(16, 2).items()}
+        for _ in range(1600):
+            state = step(state, a)
+            if bool(state.done.all()):
+                break
+        assert bool(state.done.all())
+        # timeout wins are tower-HP noisy (hard retreats, easy pushes);
+        # kills are the robust dominance signal
+        hard_wins = int((state.winning_team == lane_sim.TEAM_DIRE).sum())
+        assert hard_wins >= 7
+        assert int(state.kills[:, 1].sum()) > 5 * int(state.kills[:, 0].sum())
+
+    def test_deterministic_across_runs(self):
+        """Regression: damage/credit accumulation must use fixed-order
+        reductions — XLA scatter-add combines duplicate indices in
+        unspecified order, which made full-battle outcomes flip run to run."""
+        results = []
+        for _ in range(2):
+            spec = VecSimSpec(n_games=8, team_size=1, max_units=32,
+                              max_dota_time=120.0)
+            hero = np.ones((8, 2), np.int32)
+            ctrl = np.stack(
+                [np.full(8, pb.CONTROL_SCRIPTED_EASY),
+                 np.full(8, pb.CONTROL_SCRIPTED_HARD)], 1
+            )
+            state = J.init_state(spec, jnp.asarray(hero), jnp.asarray(ctrl),
+                                 jax.random.PRNGKey(3))
+            step = jax.jit(lambda s, a: J.step(spec, s, a))
+            a = {k: jnp.asarray(v) for k, v in noop(8, 2).items()}
+            for _ in range(400):
+                state = step(state, a)
+            results.append(jax.device_get(state))
+        for f in J.SimState._fields:
+            if f == "key":
+                continue
+            np.testing.assert_array_equal(
+                getattr(results[0], f), getattr(results[1], f),
+                err_msg=f"nondeterministic field {f}",
+            )
+
+    def test_reset_where(self):
+        spec, vsim, jstate = make_pair(n=3)
+        step = jax.jit(lambda s, a: J.step(spec, s, a))
+        a = {k: jnp.asarray(v) for k, v in noop(3, 2).items()}
+        for _ in range(50):
+            jstate = step(jstate, a)
+        mask = jnp.asarray([False, True, False])
+        jstate2 = jax.jit(lambda s, m: J.reset_where(spec, s, m))(jstate, mask)
+        assert float(jstate2.dota_time[1]) == 0.0
+        assert float(jstate2.dota_time[0]) > 0.0
+        assert bool(jstate2.alive[1, :2].all())
+        assert float(jstate2.gold[1, :2].sum()) == 0.0
+
+
+class TestJaxFeaturizerParity:
+    def test_matches_numpy_featurizer(self):
+        from dotaclient_tpu.features.jax_featurizer import JaxFeaturizer
+        from dotaclient_tpu.features.vec_featurizer import VecFeaturizer
+
+        cfg = default_config()
+        spec, vsim, jstate = make_pair(n=3)
+        step = jax.jit(lambda s, a: J.step(spec, s, a))
+        acts = noop(3, 2)
+        for _ in range(40):
+            vsim.step(acts)
+            jstate = step(jstate, {k: jnp.asarray(v) for k, v in acts.items()})
+        vf = VecFeaturizer(vsim, cfg.obs, cfg.actions, [0])
+        jf = JaxFeaturizer(spec, cfg.obs, cfg.actions, [0])
+        a = vf.featurize_all()
+        b = jax.device_get(jf.featurize(jstate))
+        assert set(a) == set(b)
+        for k in a:
+            np.testing.assert_allclose(
+                np.asarray(a[k], np.float64), np.asarray(b[k], np.float64),
+                rtol=1e-4, atol=1e-5, err_msg=f"obs field {k}",
+            )
+
+    def test_rewards_match_numpy(self):
+        from dotaclient_tpu.features.jax_featurizer import shaped_rewards
+        from dotaclient_tpu.features.vec_featurizer import VecRewards
+
+        spec, vsim, jstate = make_pair(n=3)
+        step = jax.jit(lambda s, a: J.step(spec, s, a))
+        acts = noop(3, 2)
+        jacts = {k: jnp.asarray(v) for k, v in acts.items()}
+        for _ in range(20):
+            vsim.step(acts)
+            jstate = step(jstate, jacts)
+        vr = VecRewards(vsim, [0])
+        j_prev = jstate
+        for _ in range(10):
+            vsim.step(acts)
+            jstate = step(jstate, jacts)
+        r_np = vr.compute()
+        r_j = np.asarray(
+            shaped_rewards(spec, [0], j_prev, jstate)
+        )
+        np.testing.assert_allclose(r_np, r_j, rtol=1e-4, atol=1e-5)
+
+
+class TestSequenceDoneReset:
+    def test_sequence_resets_match_stepwise(self):
+        """sequence(obs, carry0, dones) == per-step stepping with carry
+        zeroed after each done — the contract device chunks rely on."""
+        from dotaclient_tpu.models import init_params, make_policy
+        from dotaclient_tpu.models.policy import dummy_obs_batch
+
+        cfg = default_config()
+        policy = make_policy(
+            dataclasses.replace(cfg.model, dtype="float32"), cfg.obs, cfg.actions
+        )
+        params = init_params(policy, jax.random.PRNGKey(0))
+        B, T = 2, 6
+        rng = np.random.default_rng(0)
+        obs = dummy_obs_batch(B, cfg.obs, cfg.actions, time=T)
+        obs = dict(obs)
+        obs["units"] = jnp.asarray(
+            rng.normal(size=obs["units"].shape).astype(np.float32)
+        )
+        dones = jnp.asarray(
+            [[0, 0, 1, 0, 0, 0], [0, 1, 0, 0, 1, 0]], jnp.float32
+        )
+        carry0 = policy.initial_state(B)
+        logits_seq, values_seq, _ = policy.apply(
+            params, obs, carry0, dones, method="sequence"
+        )
+
+        carry = carry0
+        step_values = []
+        step_logits = []
+        for t in range(T):
+            obs_t = {k: v[:, t] for k, v in obs.items()}
+            lg, vv, carry = policy.apply(params, obs_t, carry, method="step")
+            step_values.append(vv)
+            step_logits.append(lg["action_type"])
+            keep = (1.0 - dones[:, t])[:, None]
+            carry = (carry[0] * keep, carry[1] * keep)
+        np.testing.assert_allclose(
+            np.asarray(values_seq), np.stack([np.asarray(v) for v in step_values], 1),
+            rtol=1e-5, atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits_seq["action_type"]),
+            np.stack([np.asarray(l) for l in step_logits], 1),
+            rtol=1e-5, atol=1e-5,
+        )
+
+
+class TestDeviceRollout:
+    def _actor(self, n_envs=4, opponent="scripted_easy", team_size=1, **env_kw):
+        from dotaclient_tpu.actor.device_rollout import DeviceActor
+        from dotaclient_tpu.models import init_params, make_policy
+
+        cfg = default_config()
+        cfg = dataclasses.replace(
+            cfg,
+            env=dataclasses.replace(
+                cfg.env, n_envs=n_envs, opponent=opponent,
+                team_size=team_size, max_dota_time=30.0, **env_kw,
+            ),
+            ppo=dataclasses.replace(cfg.ppo, rollout_len=8),
+        )
+        policy = make_policy(cfg.model, cfg.obs, cfg.actions)
+        params = init_params(policy, jax.random.PRNGKey(0))
+        return cfg, DeviceActor(cfg, policy, seed=0), params
+
+    def test_chunk_contract(self):
+        cfg, da, params = self._actor()
+        chunk, stats = da.collect(params)
+        T = cfg.ppo.rollout_len
+        L = da.n_lanes
+        assert chunk["obs"]["units"].shape == (
+            L, T + 1, cfg.obs.max_units, cfg.obs.unit_features
+        )
+        assert chunk["rewards"].shape == (L, T)
+        assert chunk["valid"].shape == (L, T)
+        assert (np.asarray(chunk["valid"]) == 1.0).all()
+        assert chunk["carry0"][0].shape == (L, cfg.model.hidden_dim)
+        assert set(chunk["actions"]) == set(cfg.actions.head_sizes)
+
+    def test_feeds_train_step_and_buffer(self):
+        from dotaclient_tpu.buffer import TrajectoryBuffer
+        from dotaclient_tpu.parallel import make_mesh
+        from dotaclient_tpu.train.ppo import init_train_state, make_train_step
+
+        cfg, da, params = self._actor(n_envs=8)
+        cfg = dataclasses.replace(
+            cfg,
+            ppo=dataclasses.replace(cfg.ppo, batch_rollouts=8),
+            buffer=dataclasses.replace(cfg.buffer, capacity_rollouts=32, min_fill=8),
+        )
+        mesh = make_mesh(cfg.mesh)
+        buffer = TrajectoryBuffer(cfg, mesh)
+        state = init_train_state(params, cfg.ppo)
+        step = make_train_step(da.policy, cfg, mesh)
+        chunk, _ = da.collect(params)
+        assert buffer.add_device(chunk, version=0) == 8
+        batch = buffer.take(current_version=0)
+        assert batch is not None
+        state, metrics = step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_episodes_complete_and_stats(self):
+        cfg, da, params = self._actor()
+        # 30s timeout / (8 steps * 0.2s) ≈ 19 collects per episode
+        for _ in range(25):
+            da.collect(params)
+        s = da.drain_stats()
+        assert s["episodes_done"] >= 4
+        assert s["episode_reward_mean"] != 0.0
+
+    def test_selfplay_lanes(self):
+        cfg, da, params = self._actor(opponent="selfplay")
+        assert da.n_lanes == cfg.env.n_envs * 2
+        chunk, _ = da.collect(params)
+        assert chunk["rewards"].shape[0] == da.n_lanes
+
+    def test_league_opponent_params_used(self):
+        """League mode: opponent lanes run on separate (frozen) params and
+        ship nothing; different opponent params must change the game flow."""
+        cfg, da, params = self._actor(opponent="league")
+        assert da.n_lanes == cfg.env.n_envs  # only Radiant ships
+        chunk, _ = da.collect(params, opp_params=params)
+        assert chunk["rewards"].shape[0] == cfg.env.n_envs
+
+    def test_learner_device_mode(self):
+        from dotaclient_tpu.train.learner import Learner
+
+        cfg = default_config()
+        cfg = dataclasses.replace(
+            cfg,
+            env=dataclasses.replace(
+                cfg.env, n_envs=8, opponent="scripted_easy", max_dota_time=30.0
+            ),
+            ppo=dataclasses.replace(cfg.ppo, rollout_len=8, batch_rollouts=8),
+            buffer=dataclasses.replace(cfg.buffer, capacity_rollouts=32, min_fill=8),
+            log_every=100,
+        )
+        lrn = Learner(cfg, actor="device")
+        stats = lrn.train(6)
+        assert stats["optimizer_steps"] >= 6
+        assert stats["actor_rollouts_shipped"] > 0

@@ -40,14 +40,19 @@ def random_batch(policy, params, batch=8, seed=0):
     obs["units"] = jnp.asarray(rng.normal(size=obs["units"].shape).astype(np.float32))
     obs["globals"] = jnp.asarray(rng.normal(size=obs["globals"].shape).astype(np.float32))
     b["obs"] = obs
-    logits, values, _ = policy.apply(params, obs, b["carry0"], method="sequence")
+    # dones drawn BEFORE the behavior forward: ppo_loss re-runs the sequence
+    # with the batch's dones (mid-chunk carry resets), so the behavior
+    # log-probs must come from the same done-conditioned forward
+    b["dones"] = jnp.asarray((rng.random((batch, T)) < 0.05).astype(np.float32))
+    logits, values, _ = policy.apply(
+        params, obs, b["carry0"], b["dones"], method="sequence"
+    )
     logits_t = {k: v[:, :T] for k, v in logits.items()}
     obs_t = {k: v[:, :T] for k, v in obs.items()}
     actions, logp = D.sample(jax.random.PRNGKey(seed), logits_t, obs_t)
     b["actions"] = actions
     b["behavior_logp"] = logp
     b["rewards"] = jnp.asarray(rng.normal(size=(batch, T)).astype(np.float32))
-    b["dones"] = jnp.asarray((rng.random((batch, T)) < 0.05).astype(np.float32))
     return b
 
 
