@@ -4,17 +4,21 @@ A ground-up JAX/XLA/Pallas rebuild of the capability surface of
 ``Nostrademous/dotaclient`` (PyTorch actor-learner PPO for Dota 2):
 
 - ``protos``    first-party wire format (worldstate / actions / rollouts)
-- ``envs``      lane simulator + gRPC environment service and client
-- ``features``  worldstate -> fixed-shape arrays; action codec
-- ``models``    Flax policy: unit encoders, LSTM(128) core, masked heads
-- ``ops``       GAE, masked distributions, Pallas kernels
-- ``train``     pjit'd PPO train step and learner loop
+- ``envs``      lane sim ×3: scalar (gRPC service), numpy vectorized, pure-JAX
+- ``features``  worldstate -> fixed-shape arrays (scalar/vec/jnp); action codec
+- ``models``    Flax policy: unit encoders, LSTM or transformer core, masked heads
+- ``train``     pjit'd PPO train step (GAE, clipped surrogate) and learner loop
 - ``buffer``    sharded HBM-resident trajectory ring buffer
-- ``transport`` experience/weight transport (in-proc queue, AMQP interface)
-- ``actor``     batched-on-device actor runtime multiplexing many envs
-- ``league``    self-play opponent pools and evaluation
-- ``parallel``  mesh construction, sharding rules, sequence parallelism
-- ``utils``     checkpointing, metrics, profiling
+- ``transport`` experience/weight transport (in-proc, TCP socket, AMQP)
+- ``native``    C++ runtime components (fast-path rollout wire decoder)
+- ``actor``     actors: on-device rollout scan, vectorized pool, scalar pool,
+                standalone process entrypoint (``python -m dotaclient_tpu.actor``)
+- ``league``    self-play opponent pools and win-rate evaluation
+- ``parallel``  mesh construction, TP sharding rules, ring/Ulysses sequence
+                parallelism
+- ``ops``       custom-kernel layer (Pallas candidates; see BASELINE.md for
+                the measured keep-or-kill decisions)
+- ``utils``     checkpointing (orbax, full-pipeline state), metrics
 """
 
 __version__ = "0.1.0"

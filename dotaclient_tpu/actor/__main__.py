@@ -1,0 +1,151 @@
+"""Standalone actor process — the rebuild's ``agent.py`` counterpart.
+
+The reference scale-out topology is N independent rollout-worker processes
+feeding one optimizer through a broker (SURVEY.md §1, §2.3 row 1). One such
+worker:
+
+    python -m dotaclient_tpu.actor --connect 127.0.0.1:7777 --n-envs 64
+
+connects to the learner's ``TransportServer`` (``--transport socket`` on the
+learner), pulls versioned weights from the fanout, runs the vectorized pool,
+and ships protobuf rollouts. ``--amqp host[:port]`` targets a RabbitMQ broker
+instead (cluster parity). Actors are stateless: on transport loss the process
+exits non-zero for the supervisor to restart (SURVEY.md §5.3).
+
+By default the actor pins JAX to CPU: a TPU chip admits one process, and in
+the split topology that process is the learner; set ``--platform tpu`` only
+for an actor that owns its own accelerator host.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--connect", type=str, default=None,
+                   help="learner TransportServer address host:port")
+    p.add_argument("--amqp", type=str, default=None,
+                   help="RabbitMQ broker address host[:port]")
+    p.add_argument("--n-envs", type=int, default=64)
+    p.add_argument("--opponent", type=str, default="scripted_easy")
+    p.add_argument("--team-size", type=int, default=1)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--steps", type=int, default=0,
+                   help="stop after N env steps (0 = run forever)")
+    p.add_argument("--refresh-every", type=int, default=8,
+                   help="poll for new weights every N env steps")
+    p.add_argument("--platform", type=str, default="cpu",
+                   choices=("cpu", "tpu"),
+                   help="JAX platform; cpu by default (the learner owns the TPU)")
+    args = p.parse_args(argv)
+    if bool(args.connect) == bool(args.amqp):
+        p.error("exactly one of --connect or --amqp is required")
+
+    import jax
+
+    if args.platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from dotaclient_tpu.actor.vec_runtime import VecActorPool
+    from dotaclient_tpu.config import default_config
+    from dotaclient_tpu.models import init_params, make_policy
+    from dotaclient_tpu.transport import decode_weights
+
+    if args.connect:
+        from dotaclient_tpu.transport.socket_transport import SocketTransport
+
+        host, port = args.connect.rsplit(":", 1)
+        transport = SocketTransport(host, int(port))
+    else:
+        from dotaclient_tpu.transport.queues import AmqpTransport
+
+        host, _, port = args.amqp.partition(":")
+        transport = AmqpTransport(host, int(port or 5672))
+
+    config = default_config()
+    config = dataclasses.replace(
+        config,
+        env=dataclasses.replace(
+            config.env, n_envs=args.n_envs, opponent=args.opponent,
+            team_size=args.team_size,
+        ),
+    )
+    policy = make_policy(config.model, config.obs, config.actions)
+
+    # Wait for the learner's first weights broadcast — rollouts from random
+    # init are tagged version 0 and would mix with the learner's counter.
+    local_init = init_params(policy, jax.random.PRNGKey(args.seed))
+    version = 0
+    deadline = time.time() + 60.0
+    params = None
+    while time.time() < deadline:
+        msg = transport.latest_weights()
+        if msg is not None:
+            version, tree = decode_weights(msg)
+            params = jax.tree.map(jax.numpy.asarray, tree)
+            # config-skew guard: the wire carries no config handshake, so a
+            # learner running different model/obs shapes must fail HERE with
+            # a clear message, not deep inside flax or the learner's buffer
+            if jax.tree.structure(params) != jax.tree.structure(local_init):
+                print(
+                    "actor: learner weight tree structure differs from this "
+                    "actor's model config (different core/layers?) — align "
+                    "configs", file=sys.stderr, flush=True,
+                )
+                return 2
+            mismatch = [
+                f"{jax.tree_util.keystr(path)}: learner {got.shape} vs "
+                f"actor {exp.shape}"
+                for (path, got), (_, exp) in zip(
+                    jax.tree_util.tree_flatten_with_path(params)[0],
+                    jax.tree_util.tree_flatten_with_path(local_init)[0],
+                )
+                if got.shape != exp.shape
+            ]
+            if mismatch:
+                print(
+                    "actor: learner weights do not match this actor's model "
+                    "config — align configs:\n  " + "\n  ".join(mismatch[:5]),
+                    file=sys.stderr, flush=True,
+                )
+                return 2
+            break
+        time.sleep(0.1)
+    if params is None:
+        print("actor: no weights from learner within 60s; starting from init",
+              file=sys.stderr, flush=True)
+        params = local_init
+
+    pool = VecActorPool(
+        config, policy, params, transport=transport,
+        seed=args.seed, version=version,
+    )
+    t0 = time.time()
+    try:
+        steps = 0
+        while not args.steps or steps < args.steps:
+            pool.run(args.refresh_every, refresh_every=args.refresh_every)
+            steps += args.refresh_every
+            if steps % 256 == 0:
+                s = pool.stats()
+                print(
+                    f"[actor {args.seed}] {s['env_steps']:.0f} env steps, "
+                    f"{s['rollouts_shipped']:.0f} rollouts, "
+                    f"{s['env_steps'] / max(time.time() - t0, 1e-9):.0f} steps/s, "
+                    f"version {pool.version}",
+                    flush=True,
+                )
+    except ConnectionError as e:
+        print(f"actor: transport lost ({e}); exiting for restart",
+              file=sys.stderr, flush=True)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

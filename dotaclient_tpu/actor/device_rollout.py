@@ -35,7 +35,7 @@ from dotaclient_tpu.features.jax_featurizer import (
     shaped_rewards,
 )
 from dotaclient_tpu.models import distributions as D
-from dotaclient_tpu.models.policy import Policy
+from dotaclient_tpu.models.policy import Policy, mask_carry
 from dotaclient_tpu.protos import dota_pb2 as pb
 
 
@@ -79,10 +79,12 @@ def lane_split(config: RunConfig) -> Tuple[list, list]:
 class DeviceActor:
     """Owns device-resident env+policy state; emits device chunk batches.
 
-    API parallel to the pools where it makes sense (``stats``,
-    ``set_params``/``set_opponent`` are the host-visible surface), but the
-    unit of work is ``collect(params)`` → one chunk batch [L, T, ...],
-    already on device, ready for ``TrajectoryBuffer.add_device``.
+    API parallel to the pools where it makes sense (``stats`` /
+    ``drain_stats`` are the host-visible surface); the unit of work is
+    ``collect(params, opp_params=...)`` → one chunk batch [L, T, ...],
+    already on device, ready for ``TrajectoryBuffer.add_device``. Opponent
+    params are per-call (the league pool samples a fresh opponent each
+    chunk) rather than stored setter state.
     """
 
     def __init__(self, config: RunConfig, policy: Policy, seed: int = 0) -> None:
@@ -156,9 +158,8 @@ class DeviceActor:
             else sim_mod.TEAM_DIRE
         )
 
-        carry0 = (
-            state.carry[0].astype(jnp.float32),
-            state.carry[1].astype(jnp.float32),
+        carry0 = jax.tree.map(
+            lambda t: t.astype(jnp.float32), state.carry
         )
 
         def body(c, _):
@@ -207,13 +208,12 @@ class DeviceActor:
 
             sim3 = sim_mod.reset_where(spec, sim2, done_g)
             done_lane = jnp.repeat(done_g, A)
-            keep = (~done_lane)[:, None].astype(lstm2[0].dtype)
-            lstm3 = (lstm2[0] * keep, lstm2[1] * keep)
+            lstm3 = mask_carry(lstm2, 1.0 - done_lane.astype(jnp.float32))
             if self._opp_feat is not None:
-                okeep = (~jnp.repeat(done_g, len(self.opponent_players)))[
-                    :, None
-                ].astype(opp_lstm2[0].dtype)
-                opp_lstm3 = (opp_lstm2[0] * okeep, opp_lstm2[1] * okeep)
+                opp_done = jnp.repeat(done_g, len(self.opponent_players))
+                opp_lstm3 = mask_carry(
+                    opp_lstm2, 1.0 - opp_done.astype(jnp.float32)
+                )
             else:
                 opp_lstm3 = opp_lstm2
 
@@ -277,7 +277,16 @@ class DeviceActor:
 
     def collect(self, params: Any, opp_params: Any = None):
         """Generate one chunk batch [L, T, ...] (device arrays). Returns
-        (chunk, device stats dict) — dispatch-only, no host sync."""
+        (chunk, device stats dict) — dispatch-only, no host sync.
+
+        League mode REQUIRES ``opp_params`` (the frozen opponent) — falling
+        back to the live params would silently turn the league into mirror
+        self-play."""
+        if self._opp_feat is not None and opp_params is None:
+            raise ValueError(
+                "opponent lanes exist (league mode): pass opp_params "
+                "(e.g. OpponentPool.sample(...)) to collect()"
+            )
         if opp_params is None:
             opp_params = params
         self.state, chunk, stats = self._rollout(params, self.state, opp_params)
@@ -296,6 +305,12 @@ class DeviceActor:
         self.wins += int(s["wins"])
         self._reward_sum += float(s["ep_return_sum"])
         self._ep_count_window += float(s["episodes"])
+        # windowed (since previous drain) — the responsive learning signal
+        self._recent = {
+            "episodes": float(s["episodes"]),
+            "wins": float(s["wins"]),
+            "ep_return_sum": float(s["ep_return_sum"]),
+        }
         return self.stats()
 
     def stats(self) -> Dict[str, float]:
@@ -306,6 +321,8 @@ class DeviceActor:
             if self._ep_count_window
             else 0.0
         )
+        recent = getattr(self, "_recent", None) or {}
+        r_eps = recent.get("episodes", 0.0)
         return {
             "env_steps": float(self.env_steps),
             "rollouts_shipped": float(self.rollouts_shipped),
@@ -313,5 +330,9 @@ class DeviceActor:
             "episode_reward_mean": mean_ep,
             "win_rate": (
                 self.wins / self.episodes_done if self.episodes_done else 0.0
+            ),
+            "win_rate_recent": recent.get("wins", 0.0) / r_eps if r_eps else 0.0,
+            "ep_reward_recent": (
+                recent.get("ep_return_sum", 0.0) / r_eps if r_eps else 0.0
             ),
         }

@@ -135,6 +135,11 @@ class ActorPool:
         version: int = 0,
         rollout_sink: Optional[Callable[[pb.Rollout], None]] = None,
     ) -> None:
+        if config.model.core != "lstm":
+            raise NotImplementedError(
+                "ActorPool (the scalar gRPC-parity path) supports the LSTM "
+                "core only; the vec/device actors handle any core"
+            )
         self.config = config
         self.policy = policy
         # (params, version) swap atomically as one tuple: the learner thread

@@ -44,17 +44,15 @@ def make_device_step(policy: Policy):
     ``ActorPool._device_step``): zero reset carries, split key, forward +
     sample; host-bound outputs packed into one fetch."""
 
+    from dotaclient_tpu.models.policy import mask_carry
+
     def _step(params, obs_batch, carry, key, reset_mask):
         key, sub = jax.random.split(key)
-        keep = jnp.logical_not(reset_mask)[:, None].astype(carry[0].dtype)
-        carry = (carry[0] * keep, carry[1] * keep)
+        carry = mask_carry(carry, 1.0 - reset_mask.astype(jnp.float32))
         logits, _, new_carry = policy.apply(params, obs_batch, carry, method="step")
         actions, logp = D.sample(sub, logits, obs_batch)
         packed = jnp.stack([actions[h] for h in D.HEADS], axis=1).astype(jnp.int32)
-        carry_f32 = (
-            new_carry[0].astype(jnp.float32),
-            new_carry[1].astype(jnp.float32),
-        )
+        carry_f32 = jax.tree.map(lambda t: t.astype(jnp.float32), new_carry)
         return (packed, logp, carry_f32), (new_carry, key)
 
     return jax.jit(_step)
@@ -121,7 +119,6 @@ class VecActorPool:
         L = self.feat.n_lanes
         self.n_lanes = L
         T = config.ppo.rollout_len
-        H = config.model.hidden_dim
 
         self._carry_dev = policy.initial_state(L)
         self._key_dev = jax.random.PRNGKey(seed)
@@ -138,7 +135,11 @@ class VecActorPool:
         self._rew_buf = np.zeros((L, T), np.float32)
         self._done_buf = np.zeros((L, T), np.float32)
         self._cursor = np.zeros((L,), np.int64)
-        self._carry0 = (np.zeros((L, H), np.float32), np.zeros((L, H), np.float32))
+        # carry0 snapshots: host pytree mirroring the policy's carry layout
+        # (LSTM (h, c) or transformer KV cache), f32
+        self._carry0 = jax.tree.map(
+            lambda t: np.zeros(t.shape, np.float32), self._carry_dev
+        )
         self._version0 = np.full((L,), version, np.int64)
         self._lane_reward = np.zeros((L,), np.float64)
 
@@ -281,7 +282,7 @@ class VecActorPool:
                 "rewards": self._rew_buf[l].copy(),
                 "dones": self._done_buf[l].copy(),
                 "valid": valid,
-                "carry0": (self._carry0[0][l].copy(), self._carry0[1][l].copy()),
+                "carry0": jax.tree.map(lambda b: b[l].copy(), self._carry0),
             }
             meta = {
                 "model_version": int(self._version0[l]),
@@ -296,11 +297,13 @@ class VecActorPool:
             self._cursor[l] = 0
             self._version0[l] = version
             if done:
-                self._carry0[0][l] = 0.0
-                self._carry0[1][l] = 0.0
+                for buf in jax.tree.leaves(self._carry0):
+                    buf[l] = 0.0
             else:
-                self._carry0[0][l] = carry_np[0][l]
-                self._carry0[1][l] = carry_np[1][l]
+                for buf, src in zip(
+                    jax.tree.leaves(self._carry0), jax.tree.leaves(carry_np)
+                ):
+                    buf[l] = src[l]
         if self.rollout_sink is not None:
             self.rollout_sink(out)
         elif self.transport is not None:

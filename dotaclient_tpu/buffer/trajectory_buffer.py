@@ -233,6 +233,50 @@ class TrajectoryBuffer:
         self._free.extend(int(s) for s in idx)
         return batch
 
+    # -- checkpointing -----------------------------------------------------
+
+    def state_dict(self) -> Dict[str, Any]:
+        """Full buffer state for checkpointing: the HBM ring contents plus
+        the host bookkeeping, as host arrays (SURVEY.md §5.4 — a restore
+        must not lose in-flight experience)."""
+        def padded(vals) -> np.ndarray:
+            # orbax rejects zero-size arrays: fixed capacity, -1 fill
+            out = np.full((self.capacity,), -1, np.int64)
+            out[: len(vals)] = list(vals)
+            return out
+
+        return {
+            "store": jax.tree.map(np.asarray, self._store),
+            "order": padded(self._order),
+            "free": padded(self._free),
+            "slot_version": self._slot_version.copy(),
+            "counters": np.asarray(
+                [
+                    int(self._warmed), self.dropped_stale,
+                    self.dropped_overflow, self.ingested,
+                ],
+                np.int64,
+            ),
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self._store = jax.tree.map(
+            lambda x: jax.device_put(np.asarray(x), self._sharding),
+            state["store"],
+        )
+        self._order = deque(
+            int(s) for s in np.asarray(state["order"]) if s >= 0
+        )
+        self._free = [int(s) for s in np.asarray(state["free"]) if s >= 0]
+        self._slot_version = np.asarray(state["slot_version"]).copy()
+        warmed, stale, overflow, ingested = (
+            int(v) for v in np.asarray(state["counters"])
+        )
+        self._warmed = bool(warmed)
+        self.dropped_stale = stale
+        self.dropped_overflow = overflow
+        self.ingested = ingested
+
     def metrics(self) -> Dict[str, float]:
         return {
             "buffer_size": float(self.size),

@@ -56,6 +56,7 @@ class ModelConfig:
     # Transformer-core options (scale-out path, SURVEY.md §7 step 8).
     n_layers: int = 2
     n_heads: int = 4
+    context_window: int = 16     # rolling KV-cache length (recurrent carry)
     dtype: str = "bfloat16"      # compute dtype; params stay float32
     param_dtype: str = "float32"
 

@@ -1,0 +1,11 @@
+"""League self-play: frozen-opponent pools and win-rate evaluation.
+
+SURVEY.md §7 step 7 / BASELINE.json:9-12: opponent pools with periodic
+snapshots, mixed self-play vs frozen-past sampling, and the win-rate eval
+harness the headline metric is measured with.
+"""
+
+from dotaclient_tpu.league.evaluation import evaluate
+from dotaclient_tpu.league.pool import OpponentPool, Snapshot
+
+__all__ = ["OpponentPool", "Snapshot", "evaluate"]

@@ -1,0 +1,66 @@
+"""Win-rate evaluation harness.
+
+BASELINE.json's second headline metric is *win-rate vs the hard scripted
+bot*; the reference measured it by watching TensorBoard against live games
+(SURVEY.md §4). Here it is a first-class function: play N complete
+evaluation games on the on-device sim — no training, no experience shipping
+— and report the result. Also used league-side to check whether the current
+policy beats its own frozen past (SURVEY.md §7 step 7).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from dotaclient_tpu.config import RunConfig
+from dotaclient_tpu.models.policy import Policy
+
+
+def evaluate(
+    config: RunConfig,
+    policy: Policy,
+    params: Any,
+    opponent: str = "scripted_hard",
+    opponent_params: Optional[Any] = None,
+    n_games: int = 64,
+    seed: int = 0,
+    max_chunks: Optional[int] = None,
+) -> Dict[str, float]:
+    """Play ``n_games`` full games of ``params`` vs ``opponent``.
+
+    ``opponent`` is any EnvConfig opponent mode; ``"league"`` plays against
+    ``opponent_params`` (frozen policy). Returns win_rate / episodes /
+    mean episode return. Games run on the on-device rollout loop; this
+    function is the only host sync.
+    """
+    from dotaclient_tpu.actor.device_rollout import DeviceActor
+
+    eval_cfg = dataclasses.replace(
+        config,
+        env=dataclasses.replace(config.env, n_envs=n_games, opponent=opponent),
+    )
+    actor = DeviceActor(eval_cfg, policy, seed=seed)
+    steps_per_episode = eval_cfg.env.max_dota_time / (
+        eval_cfg.env.ticks_per_observation / 30.0
+    )
+    # enough chunks for every game to finish at least once, plus slack
+    max_chunks = max_chunks or int(
+        2 * steps_per_episode / config.ppo.rollout_len + 2
+    )
+    done = 0.0
+    for _ in range(max_chunks):
+        actor.collect(params, opp_params=opponent_params)
+        if _ % 8 == 7:
+            done = actor.drain_stats()["episodes_done"]
+            if done >= n_games:
+                break
+    stats = actor.drain_stats()
+    return {
+        "win_rate": stats["win_rate"],
+        "episodes": stats["episodes_done"],
+        "episode_reward_mean": stats["episode_reward_mean"],
+    }

@@ -1,0 +1,70 @@
+"""Frozen-opponent pool for league self-play.
+
+The reference's league configs pit the live policy against frozen past
+versions of itself (SURVEY.md §7 step 7; BASELINE.json:12 "5v5 ... league
+opponents"). Mechanics here:
+
+* every ``snapshot_every`` learner steps the current params are snapshotted
+  (device-to-device copy — snapshots never touch the host) into a bounded
+  ring of ``pool_size`` frozen opponents;
+* each opponent draw plays the LATEST policy (mirror self-play) with
+  probability ``selfplay_prob``, otherwise a uniformly random frozen
+  snapshot — the standard league mix that stops strategy collapse while
+  keeping most experience near on-policy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dotaclient_tpu.config import LeagueConfig
+
+
+@dataclasses.dataclass
+class Snapshot:
+    params: Any
+    version: int
+    step: int
+
+
+class OpponentPool:
+    """Bounded ring of frozen policy snapshots + opponent sampling."""
+
+    def __init__(self, config: LeagueConfig, seed: int = 0) -> None:
+        self.config = config
+        self.snapshots: List[Snapshot] = []
+        self._rng = np.random.default_rng(seed)
+        self._last_snapshot_step: Optional[int] = None
+
+    def __len__(self) -> int:
+        return len(self.snapshots)
+
+    def maybe_snapshot(self, params: Any, version: int, step: int) -> bool:
+        """Snapshot ``params`` if ``snapshot_every`` steps have passed since
+        the last snapshot (always snapshots on the first call). The params
+        are copied on device — the caller may donate its own buffers later.
+        """
+        if (
+            self._last_snapshot_step is not None
+            and step - self._last_snapshot_step < self.config.snapshot_every
+        ):
+            return False
+        frozen = jax.tree.map(jnp.copy, params)
+        self.snapshots.append(Snapshot(frozen, version, step))
+        if len(self.snapshots) > self.config.pool_size:
+            self.snapshots.pop(0)
+        self._last_snapshot_step = step
+        return True
+
+    def sample(self, live_params: Any, live_version: int) -> Tuple[Any, int]:
+        """Draw the opponent for the next rollout batch: the live policy with
+        probability ``selfplay_prob``, else a uniform frozen snapshot."""
+        if not self.snapshots or self._rng.random() < self.config.selfplay_prob:
+            return live_params, live_version
+        snap = self.snapshots[self._rng.integers(len(self.snapshots))]
+        return snap.params, snap.version

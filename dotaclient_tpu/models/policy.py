@@ -23,7 +23,7 @@ TPU-first design decisions (SURVEY.md §7 step 3):
 
 from __future__ import annotations
 
-from typing import Dict, Mapping, Tuple
+from typing import Any, Dict, Mapping, Tuple
 
 import flax.linen as nn
 import jax
@@ -31,7 +31,19 @@ import jax.numpy as jnp
 
 from dotaclient_tpu.config import ActionSpec, ModelConfig, ObsSpec
 
-Carry = Tuple[jnp.ndarray, jnp.ndarray]
+# Recurrent carry: (h, c) for the LSTM core; (valid, KV caches) for the
+# transformer core. Always a pytree whose leaves have leading batch axis —
+# mask/zero it with mask_carry, never by unpacking tuples.
+Carry = Any
+
+
+def mask_carry(carry: Carry, keep: jnp.ndarray) -> Carry:
+    """Multiply every carry leaf by ``keep`` ([B], 0 ⇒ reset that row) —
+    core-agnostic episode-boundary reset."""
+    def m(t):
+        k = keep.reshape((-1,) + (1,) * (t.ndim - 1)).astype(t.dtype)
+        return t * k
+    return jax.tree.map(m, carry)
 
 
 def _dtype(name: str):
@@ -76,10 +88,17 @@ class Policy(nn.Module):
             cfg.hidden_dim, dtype=_dtype(cfg.dtype),
             param_dtype=_dtype(cfg.param_dtype),
         )
-        self.core = nn.OptimizedLSTMCell(
-            cfg.hidden_dim, dtype=_dtype(cfg.dtype),
-            param_dtype=_dtype(cfg.param_dtype),
-        )
+        if cfg.core == "lstm":
+            self.core = nn.OptimizedLSTMCell(
+                cfg.hidden_dim, dtype=_dtype(cfg.dtype),
+                param_dtype=_dtype(cfg.param_dtype),
+            )
+        elif cfg.core == "transformer":
+            from dotaclient_tpu.models.transformer import WindowedTransformerCore
+
+            self.core = WindowedTransformerCore(cfg)
+        else:
+            raise ValueError(f"unknown core {cfg.core!r}")
         hs = self.action_spec.head_sizes
         dtype, pdtype = _dtype(cfg.dtype), _dtype(cfg.param_dtype)
         self.head_action_type = nn.Dense(hs["action_type"], dtype=dtype, param_dtype=pdtype)
@@ -134,6 +153,12 @@ class Policy(nn.Module):
     # -- public modes ------------------------------------------------------
 
     def initial_state(self, batch_size: int) -> Carry:
+        if self.model.core == "transformer":
+            from dotaclient_tpu.models.transformer import (
+                transformer_initial_state,
+            )
+
+            return transformer_initial_state(self.model, batch_size)
         shape = (batch_size, self.model.hidden_dim)
         dtype = _dtype(self.model.dtype)
         return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
@@ -179,8 +204,7 @@ class Policy(nn.Module):
 
         def scan_step(cell, c, inp):
             xt, reset_t = inp
-            keep = (1.0 - reset_t)[:, None].astype(c[0].dtype)
-            c = (c[0] * keep, c[1] * keep)
+            c = mask_carry(c, 1.0 - reset_t)
             return cell(c, xt)
 
         scan = nn.scan(

@@ -1,0 +1,108 @@
+"""Windowed-attention transformer core with a recurrent KV-cache carry.
+
+The scale-out core option of SURVEY.md §7 step 8 (`ModelConfig.core =
+"transformer"`). Design constraint: it must be a drop-in RECURRENT cell —
+``(carry, x) -> (carry, y)`` — because the whole framework (actor pools,
+on-device rollout scan, chunk wire format, truncated-BPTT learner) is built
+on carried state (SURVEY.md §5.7). The carry is a Transformer-XL-style
+rolling window: per layer a K/V cache of the last ``context_window`` steps,
+plus a validity mask; episode resets zero the carry exactly like the LSTM
+path (an all-zero cache attends to nothing thanks to the mask).
+
+Sequence mode reuses the same cell under ``nn.scan``, so step-vs-sequence
+parity is structural, not approximate — the property the LSTM core's tests
+pin, inherited for free.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from dotaclient_tpu.config import ModelConfig
+
+
+def _dtype(name: str):
+    return {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[name]
+
+
+class _Block(nn.Module):
+    """Pre-LN attention block operating on one timestep + its KV window."""
+
+    config: ModelConfig
+
+    @nn.compact
+    def __call__(self, kv_cache, valid, h):
+        cfg = self.config
+        dtype, pdtype = _dtype(cfg.dtype), _dtype(cfg.param_dtype)
+        H, nh = cfg.hidden_dim, cfg.n_heads
+        dh = H // nh
+        kc, vc = kv_cache                                   # [B, W, H]
+        B, W = valid.shape
+
+        hn = nn.LayerNorm(dtype=dtype, param_dtype=pdtype)(h)
+        q = nn.Dense(H, dtype=dtype, param_dtype=pdtype, name="q")(hn)
+        k = nn.Dense(H, dtype=dtype, param_dtype=pdtype, name="k")(hn)
+        v = nn.Dense(H, dtype=dtype, param_dtype=pdtype, name="v")(hn)
+
+        keys = jnp.concatenate([kc.astype(dtype), k[:, None]], axis=1)
+        vals = jnp.concatenate([vc.astype(dtype), v[:, None]], axis=1)
+        mask = jnp.concatenate(
+            [valid, jnp.ones((B, 1), valid.dtype)], axis=1
+        )                                                   # [B, W+1]
+
+        qh = q.reshape(B, nh, dh)
+        kh = keys.reshape(B, W + 1, nh, dh)
+        vh = vals.reshape(B, W + 1, nh, dh)
+        logits = jnp.einsum("bhd,bkhd->bhk", qh, kh).astype(jnp.float32)
+        logits = logits / jnp.sqrt(jnp.asarray(dh, jnp.float32))
+        logits = jnp.where(mask[:, None, :] > 0, logits, -1e9)
+        w = nn.softmax(logits, axis=-1).astype(dtype)
+        out = jnp.einsum("bhk,bkhd->bhd", w, vh).reshape(B, H)
+        h = h + nn.Dense(H, dtype=dtype, param_dtype=pdtype, name="o")(out)
+
+        hm = nn.LayerNorm(dtype=dtype, param_dtype=pdtype)(h)
+        hm = nn.Dense(4 * H, dtype=dtype, param_dtype=pdtype)(hm)
+        hm = nn.gelu(hm)
+        h = h + nn.Dense(H, dtype=dtype, param_dtype=pdtype)(hm)
+
+        # roll the window: drop oldest, append this step (f32 cache — the
+        # carry crosses the wire/buffer in f32 like the LSTM state)
+        new_kc = jnp.concatenate([kc[:, 1:], k.astype(jnp.float32)[:, None]], 1)
+        new_vc = jnp.concatenate([vc[:, 1:], v.astype(jnp.float32)[:, None]], 1)
+        return (new_kc, new_vc), h
+
+
+class WindowedTransformerCore(nn.Module):
+    """Recurrent-cell interface: ``(carry, x) -> (carry, y)``.
+
+    carry = (valid [B, W] f32, ((k, v) per layer, each [B, W, H] f32)).
+    """
+
+    config: ModelConfig
+
+    @nn.compact
+    def __call__(self, carry, x):
+        cfg = self.config
+        valid, caches = carry
+        h = x.astype(_dtype(cfg.dtype))
+        new_caches = []
+        for l in range(cfg.n_layers):
+            new_kv, h = _Block(cfg, name=f"block_{l}")(caches[l], valid, h)
+            new_caches.append(new_kv)
+        B = valid.shape[0]
+        new_valid = jnp.concatenate(
+            [valid[:, 1:], jnp.ones((B, 1), valid.dtype)], axis=1
+        )
+        return (new_valid, tuple(new_caches)), h
+
+
+def transformer_initial_state(config: ModelConfig, batch_size: int):
+    W, H = config.context_window, config.hidden_dim
+    zeros = jnp.zeros((batch_size, W, H), jnp.float32)
+    return (
+        jnp.zeros((batch_size, W), jnp.float32),
+        tuple((zeros, zeros) for _ in range(config.n_layers)),
+    )

@@ -1,0 +1,16 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+SURVEY.md §2.2: where the reference leaned on native dependencies, the
+rebuild owns TPU-host-native equivalents. Currently:
+
+* ``rollout_codec`` — single-pass wire parser for `Rollout` protos feeding
+  zero-copy numpy views (the learner-ingest fast path).
+
+Build on demand (``python -m dotaclient_tpu.native.build``) or implicitly on
+first use; pure-Python fallbacks keep every environment working without a
+toolchain.
+"""
+
+from dotaclient_tpu.native.build import build, load_library
+
+__all__ = ["build", "load_library"]

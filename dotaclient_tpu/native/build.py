@@ -1,0 +1,81 @@
+"""Build/load the native library (no pybind11 in this image — plain C ABI
+via ctypes; g++ is in the base toolchain).
+
+Usage:
+    python -m dotaclient_tpu.native.build        # compile libdota_native.so
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "rollout_codec.cc")
+_LIB = os.path.join(_DIR, "libdota_native.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def build(force: bool = False) -> str:
+    """Compile the shared library if missing/stale; returns its path."""
+    with _lock:
+        if (
+            not force
+            and os.path.exists(_LIB)
+            and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)
+        ):
+            return _LIB
+        cmd = [
+            "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+            "-o", _LIB, _SRC,
+        ]
+        subprocess.run(cmd, check=True, capture_output=True)
+        return _LIB
+
+
+class TensorEntry(ctypes.Structure):
+    _fields_ = [
+        ("name_off", ctypes.c_uint32), ("name_len", ctypes.c_uint32),
+        ("dtype_off", ctypes.c_uint32), ("dtype_len", ctypes.c_uint32),
+        ("data_off", ctypes.c_uint32), ("data_len", ctypes.c_uint32),
+        ("shape", ctypes.c_int32 * 8), ("ndim", ctypes.c_int32),
+    ]
+
+
+class RolloutHeader(ctypes.Structure):
+    _fields_ = [
+        ("model_version", ctypes.c_int32), ("env_id", ctypes.c_int32),
+        ("rollout_id", ctypes.c_uint64), ("length", ctypes.c_int32),
+        ("total_reward", ctypes.c_float),
+    ]
+
+
+def load_library(auto_build: bool = True) -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    try:
+        if auto_build:
+            build()
+        lib = ctypes.CDLL(_LIB)
+        lib.dota_decode_rollout.restype = ctypes.c_int32
+        lib.dota_decode_rollout.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.POINTER(RolloutHeader),
+            ctypes.POINTER(TensorEntry), ctypes.c_int32,
+        ]
+        _lib = lib
+    except (OSError, subprocess.CalledProcessError, FileNotFoundError):
+        _load_failed = True
+        _lib = None
+    return _lib
+
+
+if __name__ == "__main__":
+    print(build(force=True))

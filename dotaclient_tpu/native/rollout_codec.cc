@@ -1,0 +1,185 @@
+// Fast-path rollout wire decoder (SURVEY.md §2.2 row 3).
+//
+// The reference's native surface for experience transport was protobuf's C++
+// runtime under the Python bindings; here the hot direction — broker bytes →
+// tensor views on the learner host — is a first-party, allocation-free wire
+// parser for the `Rollout` message of dotaclient_tpu/protos/dota.proto:
+//
+//   message TensorProto { repeated int32 shape = 1; string dtype = 2;
+//                         bytes data = 3; }
+//   message Rollout     { int32 model_version = 1; int32 env_id = 2;
+//                         uint64 rollout_id = 3; int32 length = 4;
+//                         float total_reward = 5;
+//                         map<string, TensorProto> arrays = 6; }
+//
+// The parser walks the buffer once and reports each named tensor as an
+// (offset, length) pair into the ORIGINAL buffer, so Python materializes
+// numpy arrays with zero-copy np.frombuffer views — no python-protobuf
+// object tree, no per-field PyObject churn. Exposed as plain C for ctypes
+// (pybind11 is not available in this image).
+//
+// Build: python -m dotaclient_tpu.native.build   (g++ -O2 -shared -fPIC)
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+struct Cursor {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (p < end && shift < 64) {
+      uint8_t b = *p++;
+      v |= static_cast<uint64_t>(b & 0x7f) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+    }
+    ok = false;
+    return 0;
+  }
+
+  void skip(uint64_t n) {
+    if (static_cast<uint64_t>(end - p) < n) { ok = false; return; }
+    p += n;
+  }
+
+  // Skip one field of the given wire type (after its tag was read).
+  void skip_field(uint32_t wire_type) {
+    switch (wire_type) {
+      case 0: varint(); break;                    // varint
+      case 1: skip(8); break;                     // fixed64
+      case 2: skip(varint()); break;              // length-delimited
+      case 5: skip(4); break;                     // fixed32
+      default: ok = false;
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// One decoded tensor entry: name and data are (offset, len) into the input
+// buffer; shape is materialized (tensors are at most rank 4 here; 8 is slack).
+struct TensorEntry {
+  uint32_t name_off, name_len;
+  uint32_t dtype_off, dtype_len;
+  uint32_t data_off, data_len;
+  int32_t shape[8];
+  int32_t ndim;
+};
+
+struct RolloutHeader {
+  int32_t model_version;
+  int32_t env_id;
+  uint64_t rollout_id;
+  int32_t length;
+  float total_reward;
+};
+
+// Parse one TensorProto body [p, p+len) relative to base buffer `base`.
+static bool parse_tensor(const uint8_t* base, const uint8_t* p,
+                         const uint8_t* end, TensorEntry* t) {
+  Cursor c{p, end};
+  t->ndim = 0;
+  t->dtype_len = t->data_len = 0;
+  while (c.ok && c.p < c.end) {
+    uint64_t tag = c.varint();
+    uint32_t field = tag >> 3, wt = tag & 7;
+    if (field == 1 && wt == 2) {          // packed shape
+      uint64_t n = c.varint();
+      const uint8_t* stop = c.p + n;
+      if (stop > c.end) return false;
+      while (c.ok && c.p < stop && t->ndim < 8)
+        t->shape[t->ndim++] = static_cast<int32_t>(c.varint());
+      if (c.p != stop) return false;      // >8 dims unsupported
+    } else if (field == 1 && wt == 0) {   // unpacked shape element
+      if (t->ndim < 8) t->shape[t->ndim++] = static_cast<int32_t>(c.varint());
+      else return false;
+    } else if (field == 2 && wt == 2) {   // dtype
+      uint64_t n = c.varint();
+      t->dtype_off = static_cast<uint32_t>(c.p - base);
+      t->dtype_len = static_cast<uint32_t>(n);
+      c.skip(n);
+    } else if (field == 3 && wt == 2) {   // data
+      uint64_t n = c.varint();
+      t->data_off = static_cast<uint32_t>(c.p - base);
+      t->data_len = static_cast<uint32_t>(n);
+      c.skip(n);
+    } else {
+      c.skip_field(wt);
+    }
+  }
+  return c.ok;
+}
+
+// Decode a serialized Rollout. Returns the number of tensors found, or -1 on
+// malformed input, or -2 if `max_entries` is too small. Header fields are
+// written to *hdr.
+int32_t dota_decode_rollout(const uint8_t* buf, uint64_t buf_len,
+                            RolloutHeader* hdr, TensorEntry* entries,
+                            int32_t max_entries) {
+  Cursor c{buf, buf + buf_len};
+  hdr->model_version = hdr->env_id = hdr->length = 0;
+  hdr->rollout_id = 0;
+  hdr->total_reward = 0.0f;
+  int32_t count = 0;
+  while (c.ok && c.p < c.end) {
+    uint64_t tag = c.varint();
+    if (!c.ok) return -1;
+    uint32_t field = tag >> 3, wt = tag & 7;
+    if (field == 1 && wt == 0) {
+      hdr->model_version = static_cast<int32_t>(c.varint());
+    } else if (field == 2 && wt == 0) {
+      hdr->env_id = static_cast<int32_t>(c.varint());
+    } else if (field == 3 && wt == 0) {
+      hdr->rollout_id = c.varint();
+    } else if (field == 4 && wt == 0) {
+      hdr->length = static_cast<int32_t>(c.varint());
+    } else if (field == 5 && wt == 5) {
+      if (c.end - c.p < 4) return -1;
+      std::memcpy(&hdr->total_reward, c.p, 4);
+      c.skip(4);
+    } else if (field == 6 && wt == 2) {   // map entry: key=1, value=2
+      uint64_t n = c.varint();
+      const uint8_t* stop = c.p + n;
+      if (!c.ok || stop > c.end) return -1;
+      if (count >= max_entries) return -2;
+      TensorEntry* t = &entries[count];
+      t->name_off = t->name_len = 0;
+      Cursor m{c.p, stop};
+      bool have_value = false;
+      while (m.ok && m.p < m.end) {
+        uint64_t mtag = m.varint();
+        uint32_t mf = mtag >> 3, mwt = mtag & 7;
+        if (mf == 1 && mwt == 2) {        // key
+          uint64_t kn = m.varint();
+          t->name_off = static_cast<uint32_t>(m.p - buf);
+          t->name_len = static_cast<uint32_t>(kn);
+          m.skip(kn);
+        } else if (mf == 2 && mwt == 2) { // value: TensorProto
+          uint64_t vn = m.varint();
+          if (m.p + vn > m.end) return -1;
+          if (!parse_tensor(buf, m.p, m.p + vn, t)) return -1;
+          m.skip(vn);
+          have_value = true;
+        } else {
+          m.skip_field(mwt);
+        }
+      }
+      if (!m.ok || !have_value) return -1;
+      ++count;
+      c.p = stop;
+    } else {
+      c.skip_field(wt);
+    }
+  }
+  return c.ok ? count : -1;
+}
+
+}  // extern "C"

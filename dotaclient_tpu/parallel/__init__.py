@@ -1,5 +1,12 @@
 """Parallelism library: meshes, shardings, SP/TP/PP primitives."""
 
 from dotaclient_tpu.parallel.mesh import data_sharding, make_mesh, replicated
+from dotaclient_tpu.parallel.sharding import param_spec, state_shardings
 
-__all__ = ["data_sharding", "make_mesh", "replicated"]
+__all__ = [
+    "data_sharding",
+    "make_mesh",
+    "param_spec",
+    "replicated",
+    "state_shardings",
+]

@@ -1,0 +1,46 @@
+"""Tensor-parallel sharding rules for the policy parameters.
+
+The reference has no TP — its core is an LSTM(128) on one GPU (SURVEY.md
+§2.3 row 3) — but the rebuild ships it first-class so widened cores scale
+over the mesh's ``model`` axis. GSPMD semantics make this purely a layout
+choice: annotate the parameter (and matching optimizer-state) leaves with a
+PartitionSpec and XLA emits the all-gathers/reduce-scatters over ICI; the
+math is unchanged, which the 1-vs-N equivalence test pins down.
+
+Rule (Megatron-style column sharding, applied uniformly): any parameter
+whose LAST axis is divisible by the model-axis size is sharded on that axis
+(Dense/LSTM-gate kernels ``[in, out]`` and their biases, embedding tables
+``[vocab, dim]``); everything else — tiny heads, scalars — is replicated.
+With ``model_parallel == 1`` every leaf is replicated and behavior is
+bit-identical to the data-parallel-only path.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dotaclient_tpu.config import MeshConfig
+
+
+def param_spec(shape, mesh: Mesh, config: MeshConfig) -> P:
+    """PartitionSpec for one parameter leaf under the model axis."""
+    model = config.model_axis
+    n = mesh.shape[model]
+    if n > 1 and len(shape) >= 1 and shape[-1] % n == 0 and shape[-1] >= n:
+        return P(*((None,) * (len(shape) - 1)), model)
+    return P()
+
+
+def state_shardings(state: Any, mesh: Mesh, config: MeshConfig) -> Any:
+    """Shardings for a full TrainState pytree: parameter-shaped leaves (the
+    params and Adam's mu/nu mirrors) follow :func:`param_spec`; scalars and
+    counters replicate."""
+
+    def leaf_sharding(leaf) -> NamedSharding:
+        shape = getattr(leaf, "shape", ())
+        return NamedSharding(mesh, param_spec(shape, mesh, config))
+
+    return jax.tree.map(leaf_sharding, state)

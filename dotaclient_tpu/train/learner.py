@@ -72,10 +72,18 @@ class Learner:
     ) -> None:
         # actor mode: "device" (on-device rollout scan — fastest, default for
         # training runs), "vec" (numpy vectorized sim, host-driven), "scalar"
-        # (proto/gRPC-parity pool). `vec` kept for backward compatibility.
+        # (proto/gRPC-parity pool), "external" (no in-process actors — N
+        # standalone `python -m dotaclient_tpu.actor` processes feed the
+        # transport, the reference's scale-out topology, SURVEY.md §1).
+        # `vec` kept for backward compatibility.
         mode = actor or ("vec" if vec else "scalar")
-        if mode not in ("device", "vec", "scalar"):
+        if mode not in ("device", "vec", "scalar", "external"):
             raise ValueError(f"unknown actor mode {mode!r}")
+        if mode == "external" and transport is None:
+            raise ValueError(
+                "external actor mode needs a transport (TransportServer or "
+                "AmqpTransport) for the actor processes to reach"
+            )
         self.actor_mode = mode
         self.config = config
         self.mesh = make_mesh(config.mesh)
@@ -83,6 +91,7 @@ class Learner:
         params = init_params(self.policy, jax.random.PRNGKey(config.seed))
         self.state = init_train_state(params, config.ppo)
         self.ckpt: Optional[CheckpointManager] = None
+        self._want_restore = restore
         if checkpoint_dir:
             self.ckpt = CheckpointManager(checkpoint_dir)
             if restore and self.ckpt.latest_step() is not None:
@@ -100,7 +109,9 @@ class Learner:
             if mode == "vec" else None
         )
         self.device_actor = None
-        if mode == "device":
+        if mode == "external":
+            self.pool = None
+        elif mode == "device":
             from dotaclient_tpu.actor.device_rollout import DeviceActor
 
             self.device_actor = DeviceActor(config, self.policy, seed=seed)
@@ -123,6 +134,28 @@ class Learner:
                 seed=seed,
                 version=int(self.state.version),
             )
+        # League: frozen-opponent pool driving the Dire side (SURVEY.md §7
+        # step 7). Seeded from the initial params so opponent lanes are
+        # frozen from step 0, never silently mirroring the live policy.
+        self.league = None
+        if config.env.opponent == "league":
+            if mode == "scalar":
+                raise NotImplementedError(
+                    "league mode needs frozen-opponent lanes; the scalar "
+                    "gRPC-parity pool has none — use actor='device' or 'vec'"
+                )
+            from dotaclient_tpu.league import OpponentPool
+
+            self.league = OpponentPool(config.league, seed=seed)
+            self.league.maybe_snapshot(
+                self.state.params, int(self.state.version), 0
+            )
+            if mode == "vec":
+                # live-params draws must be copies: the train step donates
+                # the learner state, killing any buffer the pool holds
+                self.pool.set_opponent(
+                    *self.league.sample(self._actor_params_copy(), 0)
+                )
         self.metrics = MetricsLogger(logdir)
         self.frames_per_rollout = config.ppo.rollout_len
         self._last_metrics: Dict[str, float] = {}
@@ -130,6 +163,14 @@ class Learner:
         # scalars costs a full sync per read, so the loop never does.
         self._host_step = int(np.asarray(self.state.step))
         self._host_version = int(np.asarray(self.state.version))
+        # Pipeline restore (buffer contents + device-actor state) happens
+        # after those components exist; weights/opt-state restored above.
+        if (
+            self._want_restore
+            and self.ckpt is not None
+            and self.ckpt.latest_step() is not None
+        ):
+            self._restore_pipeline()
 
     # -- loop --------------------------------------------------------------
 
@@ -139,6 +180,14 @@ class Learner:
             cap = self.config.buffer.capacity_rollouts
             while self._sink and len(rollouts) < cap:
                 rollouts.append(self._sink.popleft())
+            if not rollouts:
+                return 0
+            return self.buffer.add(rollouts, self._host_version)
+        if hasattr(self.transport, "consume_decoded"):
+            # socket path: raw bytes → native wire parser → zero-copy views
+            rollouts = self.transport.consume_decoded(
+                self.config.buffer.capacity_rollouts, timeout=0.001
+            )
             if not rollouts:
                 return 0
             return self.buffer.add(rollouts, self._host_version)
@@ -166,6 +215,61 @@ class Learner:
         the train step donates the state, so actors must never hold the
         learner's own buffers (they die on the next step)."""
         return jax.tree.map(jnp.copy, self.state.params)
+
+    def _pipeline_state(self) -> Dict[str, Any]:
+        """Everything beyond the TrainState a restore needs to resume the
+        exact pipeline: buffer ring + cursors, and (device mode) the actor's
+        full device state — sim worlds, recurrent carries, PRNG, episode
+        accumulators — as flat leaves (checkpoint-format-stable regardless
+        of the NamedTuple nesting)."""
+        out: Dict[str, Any] = {"buffer": self.buffer.state_dict()}
+        if self.device_actor is not None:
+            leaves = jax.tree.leaves(jax.device_get(self.device_actor.state))
+            out["actor_leaves"] = {f"{i:04d}": leaf for i, leaf in enumerate(leaves)}
+        return out
+
+    def _restore_pipeline(self) -> None:
+        restored, reason = self.ckpt.restore_pipeline(self._pipeline_state())
+        if restored is None:
+            if reason:  # mismatch is loud; a pipeline-less checkpoint is not
+                print(
+                    f"WARNING: checkpoint pipeline state not restored "
+                    f"({reason}); resuming weights-only — in-flight "
+                    f"experience and actor state are lost",
+                    flush=True,
+                )
+            return
+        self.buffer.load_state_dict(restored["buffer"])
+        if self.device_actor is not None and "actor_leaves" in restored:
+            treedef = jax.tree.structure(self.device_actor.state)
+            leaves = [
+                jnp.asarray(restored["actor_leaves"][k])
+                for k in sorted(restored["actor_leaves"])
+            ]
+            self.device_actor.state = jax.tree.unflatten(treedef, leaves)
+
+    def _publish_weights(self) -> None:
+        """Serialize current params to the transport's weights fanout (one
+        full param fetch — call at refresh cadence, not per step)."""
+        self.transport.publish_weights(
+            encode_weights(
+                jax.tree.map(np.asarray, self.state.params),
+                self._host_version,
+            )
+        )
+
+    def _refresh_league_opponent(self) -> None:
+        """Snapshot-if-due and re-draw the frozen opponent (host-pool modes;
+        the device actor samples per collect instead)."""
+        if self.league is None or self.device_actor is not None:
+            return
+        self.league.maybe_snapshot(
+            self.state.params, self._host_version, self._host_step
+        )
+        params, version = self.league.sample(
+            self._actor_params_copy(), self._host_version
+        )
+        self.pool.set_opponent(params, version)
 
     def train(
         self,
@@ -197,11 +301,10 @@ class Learner:
                 scalars = {
                     k: float(v) for k, v in jax.device_get(m).items()
                 }
-                scalars.update(
-                    self.device_actor.drain_stats()
-                    if self.device_actor is not None
-                    else self.pool.stats()
-                )
+                if self.device_actor is not None:
+                    scalars.update(self.device_actor.drain_stats())
+                elif self.pool is not None:
+                    scalars.update(self.pool.stats())
                 scalars.update(self.buffer.metrics())
                 elapsed = time.time() - t_start
                 scalars["frames_per_sec"] = frames_trained / max(elapsed, 1e-9)
@@ -210,6 +313,10 @@ class Learner:
             # `< epochs` (not `== 0`): the counter advances in strides of
             # epochs_per_batch, which may step over exact multiples.
             if self.ckpt and step % cfg.checkpoint_every < epochs:
+                # periodic saves are weights-only: the pipeline extras cost a
+                # full buffer+actor device fetch (review finding — on the
+                # tunneled link that stalls the loop for seconds); the forced
+                # end-of-run save below captures the complete pipeline
                 self.ckpt.save(self.state, cfg)
 
         if self.device_actor is not None:
@@ -218,7 +325,15 @@ class Learner:
             # so a host thread would add nothing; `overlap` is a no-op here).
             da = self.device_actor
             while steps_done < num_steps:
-                chunk, _ = da.collect(self.state.params)
+                opp_params = None
+                if self.league is not None:
+                    self.league.maybe_snapshot(
+                        self.state.params, self._host_version, self._host_step
+                    )
+                    opp_params, _ = self.league.sample(
+                        self.state.params, self._host_version
+                    )
+                chunk, _ = da.collect(self.state.params, opp_params=opp_params)
                 self.buffer.add_device(chunk, self._host_version)
                 while (
                     batch := self.buffer.take(
@@ -230,6 +345,21 @@ class Learner:
                     after_step(m)
                     if steps_done >= num_steps:
                         break
+        elif self.actor_mode == "external":
+            # Experience arrives from standalone actor processes over the
+            # transport; this loop only trains and publishes weights.
+            self._publish_weights()
+            while steps_done < num_steps:
+                self.ingest()
+                batch = self.buffer.take(current_version=self._host_version)
+                if batch is None:
+                    time.sleep(0.005)
+                    continue
+                m = self._optimize(batch)
+                steps_done += epochs
+                after_step(m)
+                if refresh_every and (steps_done // epochs) % refresh_every == 0:
+                    self._publish_weights()
         elif overlap:
             stop = threading.Event()
             actor_error: List[BaseException] = []
@@ -262,10 +392,11 @@ class Learner:
                     m = self._optimize(batch)
                     steps_done += epochs
                     after_step(m)
-                    if (steps_done // epochs) % refresh_every == 0:
+                    if refresh_every and (steps_done // epochs) % refresh_every == 0:
                         self.pool.set_params(
                             self._actor_params_copy(), self._host_version
                         )
+                        self._refresh_league_opponent()
             finally:
                 stop.set()
                 actor_thread.join(timeout=30.0)
@@ -273,6 +404,7 @@ class Learner:
             while steps_done < num_steps:
                 # Actor phase: generate experience with the current weights.
                 self.pool.set_params(self.state.params, self._host_version)
+                self._refresh_league_opponent()
                 self.pool.run(actor_steps, refresh_every=0)
                 self.ingest()
                 # Learner phase: drain full batches.
@@ -289,19 +421,18 @@ class Learner:
         if self.device_actor is not None:
             self.device_actor.drain_stats()
         # Publish final weights for out-of-process actors (cluster parity).
-        self.transport.publish_weights(
-            encode_weights(
-                jax.tree.map(np.asarray, self.state.params),
-                int(self.state.version),
-            )
-        )
+        self._publish_weights()
         if self.ckpt:
-            self.ckpt.save(self.state, cfg, force=True)
+            self.ckpt.save(
+                self.state, cfg, force=True,
+                pipeline=self._pipeline_state(),
+            )
             self.ckpt.wait()
         elapsed = time.time() - t_start
+        actor_stats = self.pool.stats() if self.pool is not None else {}
         return {
             **self._last_metrics,
-            **{f"actor_{k}": v for k, v in self.pool.stats().items()},
+            **{f"actor_{k}": v for k, v in actor_stats.items()},
             # Fresh end-of-run figures last so they win over logged snapshots.
             "optimizer_steps": float(steps_done),
             "frames_trained": float(frames_trained),
@@ -332,11 +463,32 @@ def main(argv=None) -> Dict[str, float]:
     )
     p.add_argument(
         "--actor", type=str, default=None,
-        choices=("device", "vec", "scalar"),
+        choices=("device", "vec", "scalar", "external"),
         help="actor implementation: on-device rollout scan (default), "
-        "numpy vectorized sim, or scalar proto pool",
+        "numpy vectorized sim, scalar proto pool, or external "
+        "(standalone `python -m dotaclient_tpu.actor` processes)",
+    )
+    p.add_argument(
+        "--transport", type=str, default="inproc",
+        choices=("inproc", "socket", "amqp"),
+        help="experience/weights transport; socket listens for actor "
+        "processes, amqp targets a RabbitMQ broker",
+    )
+    p.add_argument(
+        "--listen", type=str, default="127.0.0.1:7777",
+        help="host:port for --transport socket",
+    )
+    p.add_argument(
+        "--amqp-host", type=str, default="localhost",
+        help="broker address for --transport amqp",
+    )
+    p.add_argument(
+        "--refresh-every", type=int, default=10,
+        help="publish weights to actors every N optimizer steps",
     )
     args = p.parse_args(argv)
+    if args.transport != "inproc" and args.actor is None:
+        args.actor = "external"
 
     config = default_config()
     if args.smoke:
@@ -364,15 +516,31 @@ def main(argv=None) -> Dict[str, float]:
             config, env=dataclasses.replace(config.env, **env_over)
         )
 
+    transport = None
+    if args.transport == "socket":
+        from dotaclient_tpu.transport.socket_transport import TransportServer
+
+        host, port = args.listen.rsplit(":", 1)
+        transport = TransportServer(host, int(port))
+        print(f"learner: listening for actors on {transport.address}", flush=True)
+    elif args.transport == "amqp":
+        from dotaclient_tpu.transport.queues import AmqpTransport
+
+        host, _, port = args.amqp_host.partition(":")
+        transport = AmqpTransport(host, int(port or 5672))
+
     learner = Learner(
         config,
+        transport=transport,
         logdir=args.logdir,
         checkpoint_dir=args.checkpoint_dir,
         restore=args.restore,
         seed=args.seed,
         actor=args.actor or ("scalar" if args.no_vec else "device"),
     )
-    stats = learner.train(args.steps, overlap=args.overlap)
+    stats = learner.train(
+        args.steps, overlap=args.overlap, refresh_every=args.refresh_every
+    )
     print(
         f"done: {stats['optimizer_steps']:.0f} steps, "
         f"{stats['frames_trained']:.0f} frames, "

@@ -159,18 +159,32 @@ def make_train_step(
     """Compile the train step against ``mesh``.
 
     Batch arrays are sharded over the data axis (leading/batch dim); the
-    train state is replicated. XLA inserts the gradient all-reduce over ICI.
-    The train state is donated — params/opt-state update in place in HBM.
+    train state follows the tensor-parallel rules of
+    ``parallel.sharding.state_shardings`` — replicated when
+    ``model_parallel == 1``, last-axis-sharded kernels over the model axis
+    otherwise. XLA inserts the gradient all-reduce (data axis) and the TP
+    collectives (model axis) over ICI. The train state is donated —
+    params/opt-state update in place in HBM.
     """
+    from dotaclient_tpu.models import init_params
+    from dotaclient_tpu.parallel.sharding import state_shardings
+
     data_sharding = NamedSharding(mesh, P(config.mesh.data_axis))
     repl = NamedSharding(mesh, P())
     batch_shardings = jax.tree.map(
         lambda _: data_sharding, example_batch(config, batch=1, as_struct=True)
     )
+    state_shape = jax.eval_shape(
+        lambda: init_train_state(
+            init_params(policy, jax.random.PRNGKey(0)), config.ppo
+        )
+    )
+    state_sharding = state_shardings(state_shape, mesh, config.mesh)
+    metrics_repl = repl
     step_fn = jax.jit(
         lambda state, batch: _train_step(policy, config.ppo, state, batch),
-        in_shardings=(repl, batch_shardings),
-        out_shardings=(repl, repl),
+        in_shardings=(state_sharding, batch_shardings),
+        out_shardings=(state_sharding, metrics_repl),
         donate_argnums=(0,),
     )
     return step_fn
@@ -178,11 +192,16 @@ def make_train_step(
 
 def example_batch(config: RunConfig, batch: int, as_struct: bool = False) -> Batch:
     """A correctly-shaped zero batch (compile warm-up, tests, AOT)."""
-    from dotaclient_tpu.models.policy import dummy_obs_batch
+    from dotaclient_tpu.models.policy import dummy_obs_batch, make_policy
 
     T = config.ppo.rollout_len
-    H = config.model.hidden_dim
     obs = dummy_obs_batch(batch, config.obs, config.actions, time=T + 1)
+    # carry0 layout comes from the policy's own core (LSTM (h, c) or a
+    # transformer KV cache); the wire/buffer representation is always f32
+    carry0 = jax.tree.map(
+        lambda t: jnp.zeros(t.shape, jnp.float32),
+        make_policy(config.model, config.obs, config.actions).initial_state(batch),
+    )
     out: Batch = {
         "obs": obs,
         "actions": {
@@ -193,10 +212,7 @@ def example_batch(config: RunConfig, batch: int, as_struct: bool = False) -> Bat
         "rewards": jnp.zeros((batch, T), jnp.float32),
         "dones": jnp.zeros((batch, T), jnp.float32),
         "valid": jnp.ones((batch, T), jnp.float32),
-        "carry0": (
-            jnp.zeros((batch, H), jnp.float32),
-            jnp.zeros((batch, H), jnp.float32),
-        ),
+        "carry0": carry0,
     }
     if as_struct:
         return jax.tree.map(

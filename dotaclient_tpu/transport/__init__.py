@@ -5,6 +5,10 @@ from dotaclient_tpu.transport.queues import (
     InProcTransport,
     Transport,
 )
+from dotaclient_tpu.transport.socket_transport import (
+    SocketTransport,
+    TransportServer,
+)
 from dotaclient_tpu.transport.serialize import (
     decode_rollout,
     decode_weights,
@@ -19,7 +23,9 @@ from dotaclient_tpu.transport.serialize import (
 __all__ = [
     "AmqpTransport",
     "InProcTransport",
+    "SocketTransport",
     "Transport",
+    "TransportServer",
     "decode_rollout",
     "decode_weights",
     "encode_rollout",

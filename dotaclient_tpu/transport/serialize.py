@@ -7,12 +7,16 @@ slash-joined paths of the training-batch pytree (``obs/units``,
 ``actions/move_x``, ``carry0/h``, ...). The same codec serves the learner→
 actor weights direction (``ModelWeights``).
 
-Decode is the hot ingestion path; a C++ fast-path decoder with the same wire
-format backs ``decode_rollout`` when built (SURVEY.md §2.2).
+Decode is the hot ingestion path; ``decode_rollout_bytes`` uses the
+first-party C++ wire parser (``dotaclient_tpu/native/rollout_codec.cc``,
+single pass, zero-copy numpy views) when the native library is built, with
+a pure-protobuf fallback otherwise (SURVEY.md §2.2 row 3).
 """
 
 from __future__ import annotations
 
+import ctypes
+import threading
 from typing import Any, Dict, Mapping, Tuple
 
 import numpy as np
@@ -120,6 +124,91 @@ def decode_rollout(r: pb.Rollout) -> Tuple[Dict[str, Any], Any]:
     }
     flat = {name: proto_to_tensor(t) for name, t in r.arrays.items()}
     return meta, unflatten_tree(flat)
+
+
+_MAX_TENSORS = 64
+# structured view over the C TensorEntry array — field access is vectorized
+# numpy instead of per-attribute ctypes getattr
+_ENTRY_DTYPE = np.dtype(
+    [
+        ("name_off", "<u4"), ("name_len", "<u4"),
+        ("dtype_off", "<u4"), ("dtype_len", "<u4"),
+        ("data_off", "<u4"), ("data_len", "<u4"),
+        ("shape", "<i4", (8,)), ("ndim", "<i4"),
+    ]
+)
+_DTYPE_CACHE: Dict[bytes, np.dtype] = {}
+_tls = threading.local()
+
+
+def _entry_buffer():
+    buf = getattr(_tls, "entries", None)
+    if buf is None:
+        buf = np.zeros(_MAX_TENSORS, _ENTRY_DTYPE)
+        _tls.entries = buf
+    return buf
+
+
+def decode_rollout_bytes(
+    payload: bytes, native: bool = True
+) -> Tuple[Dict[str, Any], Any]:
+    """Decode a serialized ``Rollout`` from raw bytes.
+
+    The learner-ingest fast path: with the native library built (see
+    ``dotaclient_tpu.native``), one C pass locates every tensor and the
+    arrays are materialized as zero-copy ``np.frombuffer`` views into
+    ``payload``; otherwise falls back to python-protobuf. Views are
+    read-only — callers that mutate must copy (the trajectory buffer only
+    uploads, so the hot path never does).
+    """
+    if native:
+        from dotaclient_tpu.native.build import (
+            RolloutHeader,
+            TensorEntry,
+            load_library,
+        )
+
+        lib = load_library()
+        if lib is not None:
+            hdr = RolloutHeader()
+            entries = _entry_buffer()
+            n = lib.dota_decode_rollout(
+                payload, len(payload), ctypes.byref(hdr),
+                entries.ctypes.data_as(ctypes.POINTER(TensorEntry)),
+                _MAX_TENSORS,
+            )
+            if n >= 0:
+                flat = {}
+                # one C-level conversion: rows become plain python tuples
+                for (
+                    name_off, name_len, dtype_off, dtype_len,
+                    data_off, data_len, shape, ndim,
+                ) in entries[:n].tolist():
+                    name = payload[name_off:name_off + name_len].decode()
+                    dkey = payload[dtype_off:dtype_off + dtype_len]
+                    dtype = _DTYPE_CACHE.get(dkey)
+                    if dtype is None:
+                        dtype = _np_dtype(dkey.decode())
+                        _DTYPE_CACHE[dkey] = dtype
+                    count = data_len // dtype.itemsize
+                    arr = np.frombuffer(
+                        payload, dtype=dtype, count=count, offset=data_off
+                    )
+                    if ndim != 1 or shape[0] != count:
+                        arr = arr.reshape(shape[:ndim])
+                    flat[name] = arr
+                meta = {
+                    "model_version": hdr.model_version,
+                    "env_id": hdr.env_id,
+                    "rollout_id": hdr.rollout_id,
+                    "length": hdr.length,
+                    "total_reward": hdr.total_reward,
+                }
+                return meta, unflatten_tree(flat)
+            # n == -2 (too many tensors) or malformed: fall through
+    r = pb.Rollout()
+    r.ParseFromString(payload)
+    return decode_rollout(r)
 
 
 def encode_weights(params: Any, version: int) -> pb.ModelWeights:

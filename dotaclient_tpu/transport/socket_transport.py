@@ -1,0 +1,313 @@
+"""Cross-process transport over localhost/LAN TCP sockets.
+
+The reference's multi-process topology is N ``agent.py`` processes → broker →
+one optimizer (SURVEY.md §1). The broker there is RabbitMQ; this module
+provides the same two channels (experience work-queue up, weights fanout
+down) over plain length-prefixed protobuf frames so the topology runs
+anywhere — including this sandbox, which has no broker — with
+``AmqpTransport`` remaining the drop-in for clusters that do run one.
+
+Wire format per frame: 1 byte kind (0 = Rollout, 1 = ModelWeights) +
+4 bytes big-endian payload length + payload bytes.
+
+* ``TransportServer`` — learner side. Owns the listening socket; every
+  connected actor's rollouts funnel into one internal queue (work-queue
+  semantics), and each ``publish_weights`` is fanned out to every connection
+  (latest-wins on the actor side). Implements the ``Transport`` protocol so
+  the learner uses it exactly like ``InProcTransport``.
+* ``SocketTransport`` — actor side. Connects out, publishes rollouts,
+  tracks the latest weights broadcast.
+
+Failure model matches the reference's (SURVEY.md §5.3): actors are
+stateless and disposable — a dead connection is dropped silently server-side
+(its in-flight rollouts are lost, exactly like a RMQ consumer crash), and an
+actor that loses the learner exits with an error for the supervisor
+(k8s/systemd) to restart.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+from typing import List, Optional, Tuple
+
+from dotaclient_tpu.protos import dota_pb2 as pb
+
+_KIND_ROLLOUT = 0
+_KIND_WEIGHTS = 1
+_HEADER = struct.Struct(">BI")
+MAX_FRAME = 512 * 1024 * 1024
+
+
+def _send_frame(sock: socket.socket, kind: int, payload: bytes) -> None:
+    sock.sendall(_HEADER.pack(kind, len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock: socket.socket) -> Optional[Tuple[int, bytes]]:
+    head = _recv_exact(sock, _HEADER.size)
+    if head is None:
+        return None
+    kind, length = _HEADER.unpack(head)
+    if length > MAX_FRAME:
+        raise ValueError(f"frame of {length} bytes exceeds MAX_FRAME")
+    payload = _recv_exact(sock, length)
+    if payload is None:
+        return None
+    return kind, payload
+
+
+class TransportServer:
+    """Learner-side transport: accept actors, merge their experience."""
+
+    def __init__(
+        self, host: str = "127.0.0.1", port: int = 0, max_rollouts: int = 4096
+    ) -> None:
+        self._listener = socket.create_server((host, port))
+        self.address = self._listener.getsockname()
+        self._rollouts: "queue.Queue[bytes]" = queue.Queue(max_rollouts)
+        self._conns: List[socket.socket] = []
+        self._conns_lock = threading.Lock()
+        # per-connection send locks: the accept-loop's late-joiner weights
+        # frame and publish_weights may target the same socket concurrently,
+        # and interleaved sendall() corrupts the framed stream
+        self._send_locks: dict = {}
+        self.bad_payloads = 0
+        self._latest_weights: Optional[pb.ModelWeights] = None
+        self._weights_lock = threading.Lock()
+        self._closed = threading.Event()
+        self.dropped = 0
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="transport-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    # -- internals ---------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                conn, _addr = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conns_lock:
+                self._conns.append(conn)
+                self._send_locks[conn] = threading.Lock()
+                # late joiner gets the current weights immediately
+                weights = self._latest_weights
+            if weights is not None:
+                if not self._locked_send(
+                    conn, _KIND_WEIGHTS, weights.SerializeToString()
+                ):
+                    continue
+            threading.Thread(
+                target=self._reader_loop, args=(conn,),
+                name="transport-reader", daemon=True,
+            ).start()
+
+    def _reader_loop(self, conn: socket.socket) -> None:
+        try:
+            while not self._closed.is_set():
+                frame = _recv_frame(conn)
+                if frame is None:
+                    break
+                kind, payload = frame
+                if kind != _KIND_ROLLOUT:
+                    continue
+                # raw bytes are queued; parsing happens on the consumer via
+                # the native fast-path decoder (consume_decoded) or protobuf
+                while True:
+                    try:
+                        self._rollouts.put_nowait(payload)
+                        break
+                    except queue.Full:  # drop-oldest backpressure
+                        try:
+                            self._rollouts.get_nowait()
+                            self.dropped += 1
+                        except queue.Empty:
+                            pass
+        except (OSError, ValueError):
+            pass  # dead actor: stateless, just drop it (SURVEY.md §5.3)
+        finally:
+            self._drop(conn)
+
+    def _locked_send(self, conn: socket.socket, kind: int, payload: bytes) -> bool:
+        with self._conns_lock:
+            lock = self._send_locks.get(conn)
+        if lock is None:
+            return False
+        try:
+            with lock:
+                _send_frame(conn, kind, payload)
+            return True
+        except OSError:
+            self._drop(conn)
+            return False
+
+    def _drop(self, conn: socket.socket) -> None:
+        with self._conns_lock:
+            if conn in self._conns:
+                self._conns.remove(conn)
+            self._send_locks.pop(conn, None)
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    # -- Transport protocol (learner side) ---------------------------------
+
+    def publish_rollout(self, rollout: pb.Rollout) -> None:
+        raise RuntimeError("TransportServer is the learner side; actors publish")
+
+    def _drain(self, max_count: int, timeout: Optional[float]) -> List[bytes]:
+        out: List[bytes] = []
+        try:
+            out.append(self._rollouts.get(timeout=timeout))
+        except queue.Empty:
+            return out
+        while len(out) < max_count:
+            try:
+                out.append(self._rollouts.get_nowait())
+            except queue.Empty:
+                break
+        return out
+
+    def consume_rollouts(
+        self, max_count: int, timeout: Optional[float] = None
+    ) -> List[pb.Rollout]:
+        protos = []
+        for payload in self._drain(max_count, timeout):
+            r = pb.Rollout()
+            try:
+                r.ParseFromString(payload)
+            except Exception:  # malformed sender: drop, never kill the learner
+                self.bad_payloads += 1
+                continue
+            protos.append(r)
+        return protos
+
+    def consume_decoded(self, max_count: int, timeout: Optional[float] = None):
+        """Drain as decoded (meta, arrays) pairs via the native fast-path
+        wire parser — the learner-ingest hot path (SURVEY.md §2.2 row 3).
+        Malformed payloads (version-skewed actors, port scanners) are counted
+        and dropped — the disposable-actor failure model, SURVEY.md §5.3."""
+        from dotaclient_tpu.transport.serialize import decode_rollout_bytes
+
+        out = []
+        for p in self._drain(max_count, timeout):
+            try:
+                out.append(decode_rollout_bytes(p))
+            except Exception:
+                self.bad_payloads += 1
+        return out
+
+    def publish_weights(self, weights: pb.ModelWeights) -> None:
+        payload = weights.SerializeToString()
+        with self._weights_lock:
+            self._latest_weights = weights
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            self._locked_send(conn, _KIND_WEIGHTS, payload)
+
+    def latest_weights(self) -> Optional[pb.ModelWeights]:
+        with self._weights_lock:
+            return self._latest_weights
+
+    @property
+    def n_connected(self) -> int:
+        with self._conns_lock:
+            return len(self._conns)
+
+    @property
+    def pending_rollouts(self) -> int:
+        return self._rollouts.qsize()
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._conns_lock:
+            for conn in self._conns:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+
+
+class SocketTransport:
+    """Actor-side transport: connect to the learner's ``TransportServer``."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0) -> None:
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(None)
+        self._send_lock = threading.Lock()
+        self._weights_lock = threading.Lock()
+        self._latest_weights: Optional[pb.ModelWeights] = None
+        self._dead: Optional[BaseException] = None
+        self._reader = threading.Thread(
+            target=self._reader_loop, name="weights-reader", daemon=True
+        )
+        self._reader.start()
+
+    def _reader_loop(self) -> None:
+        try:
+            while True:
+                frame = _recv_frame(self._sock)
+                if frame is None:
+                    raise ConnectionError("learner closed the connection")
+                kind, payload = frame
+                if kind != _KIND_WEIGHTS:
+                    continue
+                msg = pb.ModelWeights()
+                msg.ParseFromString(payload)
+                with self._weights_lock:
+                    self._latest_weights = msg
+        except BaseException as e:
+            self._dead = e
+
+    def _check(self) -> None:
+        if self._dead is not None:
+            raise ConnectionError(
+                "transport connection lost; actor should exit and be restarted"
+            ) from self._dead
+
+    def publish_rollout(self, rollout: pb.Rollout) -> None:
+        self._check()
+        with self._send_lock:
+            _send_frame(self._sock, _KIND_ROLLOUT, rollout.SerializeToString())
+
+    def consume_rollouts(
+        self, max_count: int, timeout: Optional[float] = None
+    ) -> List[pb.Rollout]:
+        raise RuntimeError("SocketTransport is the actor side; learner consumes")
+
+    def publish_weights(self, weights: pb.ModelWeights) -> None:
+        raise RuntimeError("actors do not publish weights")
+
+    def latest_weights(self) -> Optional[pb.ModelWeights]:
+        self._check()
+        with self._weights_lock:
+            return self._latest_weights
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -33,24 +33,66 @@ class CheckpointManager:
             ),
         )
 
-    def save(self, state: TrainState, config: RunConfig, force: bool = False) -> bool:
+    def save(
+        self,
+        state: TrainState,
+        config: RunConfig,
+        force: bool = False,
+        pipeline: Optional[Any] = None,
+    ) -> bool:
+        """Save the train state (+ config); ``pipeline`` optionally carries
+        the rest of the system — trajectory-buffer contents/cursors and the
+        actor's device state (sim, carries, PRNG) — so a restore resumes the
+        EXACT pipeline, not just the weights (SURVEY.md §5.4; VERDICT round 1
+        item 9)."""
         step = int(state.step)
-        saved = self._mgr.save(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardSave(
-                    {
-                        "step": np.asarray(state.step),
-                        "version": np.asarray(state.version),
-                        "params": jax.tree.map(np.asarray, state.params),
-                        "opt_state": jax.tree.map(np.asarray, state.opt_state),
-                    }
-                ),
-                config=ocp.args.JsonSave(dataclasses.asdict(config)),
+        items = dict(
+            state=ocp.args.StandardSave(
+                {
+                    "step": np.asarray(state.step),
+                    "version": np.asarray(state.version),
+                    "params": jax.tree.map(np.asarray, state.params),
+                    "opt_state": jax.tree.map(np.asarray, state.opt_state),
+                }
             ),
-            force=force,
+            config=ocp.args.JsonSave(dataclasses.asdict(config)),
+        )
+        if pipeline is not None:
+            items["pipeline"] = ocp.args.StandardSave(
+                jax.tree.map(np.asarray, pipeline)
+            )
+        saved = self._mgr.save(
+            step, args=ocp.args.Composite(**items), force=force
         )
         return bool(saved)
+
+    def restore_pipeline(self, template: Any) -> Tuple[Optional[Any], str]:
+        """Restore the pipeline extras of the latest step into ``template``'s
+        structure. Returns (state, "") on success; (None, "") when the
+        checkpoint simply has no pipeline entry; (None, reason) when one
+        exists but could not be restored (shape/layout mismatch) — callers
+        must surface that loudly, not silently degrade."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return None, ""
+        try:
+            has_pipeline = "pipeline" in (self._mgr.item_metadata(step) or {})
+        except Exception:
+            has_pipeline = True  # unknown: attempt and report failure
+        if not has_pipeline:
+            return None, ""
+        try:
+            restored = self._mgr.restore(
+                step,
+                args=ocp.args.Composite(
+                    pipeline=ocp.args.StandardRestore(
+                        jax.tree.map(np.asarray, template)
+                    )
+                ),
+            )
+        except (KeyError, FileNotFoundError, ValueError, TypeError) as e:
+            return None, f"{type(e).__name__}: {e}"
+        return restored["pipeline"], ""
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()

@@ -1,0 +1,119 @@
+"""Seeded learning demonstration (VERDICT round 1, "Demonstrate learning").
+
+One command reproduces the numbers recorded in BASELINE.md:
+
+    python scripts/train_demo.py                # full demo (~10-20 min on TPU)
+    python scripts/train_demo.py --steps 2000   # shorter sanity run
+
+Protocol:
+1. evaluate the INITIAL policy vs the easy and hard scripted bots;
+2. train vs scripted_easy (seeded, fixed config) with periodic windowed
+   reward/win-rate logging — the rising-reward curve;
+3. evaluate the TRAINED policy vs scripted_easy, scripted_hard, and its own
+   initial self (league-mode eval vs the frozen step-0 snapshot);
+4. print one JSON summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--steps", type=int, default=20_000)
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--n-envs", type=int, default=128)
+    p.add_argument("--eval-games", type=int, default=64)
+    p.add_argument("--logdir", type=str, default=None)
+    args = p.parse_args()
+
+    from dotaclient_tpu.config import default_config
+    from dotaclient_tpu.league import evaluate
+    from dotaclient_tpu.train.learner import Learner
+
+    config = default_config()
+    config = dataclasses.replace(
+        config,
+        env=dataclasses.replace(
+            config.env, n_envs=args.n_envs, opponent="scripted_easy",
+            max_dota_time=300.0,
+        ),
+        buffer=dataclasses.replace(
+            config.buffer, capacity_rollouts=512, min_fill=128
+        ),
+        log_every=10_000,
+        seed=args.seed,
+    )
+    learner = Learner(config, actor="device", seed=args.seed, logdir=args.logdir)
+    policy = learner.policy
+    init_params = jax.tree.map(lambda x: x.copy(), learner.state.params)
+
+    print("== eval: INITIAL policy ==", flush=True)
+    init_easy = evaluate(config, policy, init_params, "scripted_easy",
+                         n_games=args.eval_games, seed=7)
+    init_hard = evaluate(config, policy, init_params, "scripted_hard",
+                         n_games=args.eval_games, seed=7)
+    print(f"init vs easy: {init_easy}", flush=True)
+    print(f"init vs hard: {init_hard}", flush=True)
+
+    print(f"== train: {args.steps} optimizer steps vs scripted_easy ==", flush=True)
+    t0 = time.time()
+    block = 1000
+    curve = []
+    done_steps = 0
+    while done_steps < args.steps:
+        n = min(block, args.steps - done_steps)
+        learner.train(n)
+        done_steps += n
+        s = learner.device_actor.stats()
+        curve.append(
+            {
+                "step": done_steps,
+                "win_rate_recent": round(s["win_rate_recent"], 3),
+                "ep_reward_recent": round(s["ep_reward_recent"], 3),
+            }
+        )
+        print(
+            f"[{time.time() - t0:7.1f}s] step {done_steps}: "
+            f"win_rate_recent={s['win_rate_recent']:.3f} "
+            f"ep_reward_recent={s['ep_reward_recent']:.2f} "
+            f"episodes={s['episodes_done']:.0f}",
+            flush=True,
+        )
+
+    trained = jax.tree.map(lambda x: x.copy(), learner.state.params)
+    print("== eval: TRAINED policy ==", flush=True)
+    final_easy = evaluate(config, policy, trained, "scripted_easy",
+                          n_games=args.eval_games, seed=7)
+    final_hard = evaluate(config, policy, trained, "scripted_hard",
+                          n_games=args.eval_games, seed=7)
+    vs_past = evaluate(config, policy, trained, "league",
+                       opponent_params=init_params,
+                       n_games=args.eval_games, seed=7)
+    summary = {
+        "steps": args.steps,
+        "frames": args.steps * config.ppo.batch_rollouts * config.ppo.rollout_len,
+        "wall_sec": round(time.time() - t0, 1),
+        "init_win_vs_easy": round(init_easy["win_rate"], 3),
+        "init_win_vs_hard": round(init_hard["win_rate"], 3),
+        "final_win_vs_easy": round(final_easy["win_rate"], 3),
+        "final_win_vs_hard": round(final_hard["win_rate"], 3),
+        "final_win_vs_initial_self": round(vs_past["win_rate"], 3),
+        "reward_first_block": curve[0]["ep_reward_recent"] if curve else None,
+        "reward_last_block": curve[-1]["ep_reward_recent"] if curve else None,
+    }
+    print("DEMO_SUMMARY " + json.dumps(summary), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,102 @@
+"""Pipeline-complete checkpointing: kill-and-resume equivalence.
+
+SURVEY.md §5.4 / VERDICT round 1 item 9: a restore must resume the EXACT
+pipeline — params, optimizer state, counters, the HBM trajectory ring with
+its cursors, and the device actor's full state (sim worlds, recurrent
+carries, PRNG, episode accumulators). The pin: train A for k steps,
+checkpoint, keep training A; build B from the checkpoint alone; A and B must
+produce identical subsequent metrics.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from dotaclient_tpu.config import default_config
+from dotaclient_tpu.train.learner import Learner
+
+
+def small_config():
+    cfg = default_config()
+    return dataclasses.replace(
+        cfg,
+        env=dataclasses.replace(cfg.env, n_envs=4, max_dota_time=30.0),
+        ppo=dataclasses.replace(cfg.ppo, rollout_len=8, batch_rollouts=8),
+        buffer=dataclasses.replace(cfg.buffer, capacity_rollouts=32, min_fill=8),
+        log_every=1,
+        checkpoint_every=1_000_000,  # only explicit/force saves
+    )
+
+
+class TestKillAndResume:
+    def test_resume_reproduces_metrics(self, tmp_path):
+        cfg = small_config()
+        ckdir = str(tmp_path / "ck")
+
+        # A: train, snapshot the full pipeline at step 3, keep training
+        # (A itself has no checkpoint dir, so step 3 stays the latest)
+        from dotaclient_tpu.utils.checkpoint import CheckpointManager
+
+        a = Learner(cfg, seed=3, actor="device")
+        a.train(3)
+        mgr = CheckpointManager(ckdir)
+        mgr.save(a.state, cfg, force=True, pipeline=a._pipeline_state())
+        mgr.wait()
+        a.train(3)
+        a_metrics = dict(a._last_metrics)
+
+        # B: a fresh process-equivalent, restored from the checkpoint alone
+        b = Learner(
+            cfg, checkpoint_dir=ckdir, restore=True, seed=999,  # seed unused
+            actor="device",
+        )
+        assert b._host_step == 3
+        b.train(3)
+        b_metrics = dict(b._last_metrics)
+
+        for k in ("loss", "policy_loss", "value_loss", "entropy", "reward_mean"):
+            assert a_metrics[k] == pytest.approx(b_metrics[k], rel=1e-5), (
+                f"metric {k} diverged after resume: {a_metrics[k]} vs {b_metrics[k]}"
+            )
+
+    def test_restore_without_pipeline_still_works(self, tmp_path):
+        """Weights-only checkpoints (no pipeline entry) restore cleanly."""
+        cfg = small_config()
+        ckdir = str(tmp_path / "ck")
+        a = Learner(cfg, seed=0, actor="device")
+        a.train(2)
+        from dotaclient_tpu.utils.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(ckdir)
+        mgr.save(a.state, cfg, force=True)  # weights-only, no pipeline
+        mgr.wait()
+        b = Learner(cfg, checkpoint_dir=ckdir, restore=True, actor="device")
+        assert b._host_step == 2
+        stats = b.train(2)
+        assert stats["optimizer_steps"] >= 2
+
+    def test_buffer_contents_survive(self, tmp_path):
+        """In-flight experience is not lost across a restore."""
+        from dotaclient_tpu.buffer import TrajectoryBuffer
+        from dotaclient_tpu.parallel import make_mesh
+
+        cfg = small_config()
+        a = Learner(cfg, seed=1, actor="device")
+        for _ in range(2):  # 2 × n_envs rollouts ≥ min_fill
+            chunk, _ = a.device_actor.collect(a.state.params)
+            a.buffer.add_device(chunk, 0)
+        assert a.buffer.size >= cfg.buffer.min_fill
+        state = a.buffer.state_dict()
+
+        mesh = make_mesh(cfg.mesh)
+        fresh = TrajectoryBuffer(cfg, mesh)
+        assert fresh.size == 0
+        fresh.load_state_dict(jax.tree.map(np.asarray, state))
+        assert fresh.size == a.buffer.size
+        batch = fresh.take(batch_size=8)
+        assert batch is not None
+        np.testing.assert_array_equal(
+            np.asarray(batch["valid"]), np.ones_like(np.asarray(batch["valid"]))
+        )

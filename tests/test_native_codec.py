@@ -1,0 +1,90 @@
+"""Native rollout-codec tests: build, exact parity with the protobuf path,
+zero-copy semantics, malformed-input fallback (SURVEY.md §2.2 row 3)."""
+
+import numpy as np
+import pytest
+
+from dotaclient_tpu.transport.serialize import (
+    decode_rollout,
+    decode_rollout_bytes,
+    encode_rollout,
+)
+from dotaclient_tpu.protos import dota_pb2 as pb
+
+
+@pytest.fixture(scope="module")
+def native_lib():
+    from dotaclient_tpu.native.build import load_library
+
+    lib = load_library()
+    if lib is None:
+        pytest.skip("native toolchain unavailable")
+    return lib
+
+
+def sample_rollout(seed=0):
+    rng = np.random.default_rng(seed)
+    arrays = {
+        "obs": {
+            "units": rng.normal(size=(17, 32, 22)).astype(np.float32),
+            "unit_mask": rng.random((17, 32)) > 0.5,
+            "hero_id": np.arange(17, dtype=np.int32),
+        },
+        "rewards": rng.normal(size=(16,)).astype(np.float32),
+        "dones": np.zeros((16,), np.float32),
+        "carry0": (
+            rng.normal(size=(128,)).astype(np.float32),
+            rng.normal(size=(128,)).astype(np.float32),
+        ),
+    }
+    return encode_rollout(
+        arrays, model_version=7, env_id=3, rollout_id=123456789,
+        length=16, total_reward=-2.5,
+    )
+
+
+class TestNativeCodec:
+    def test_exact_parity_with_protobuf(self, native_lib):
+        import jax
+
+        r = sample_rollout()
+        payload = r.SerializeToString()
+        m_py, a_py = decode_rollout(r)
+        m_nat, a_nat = decode_rollout_bytes(payload, native=True)
+        assert m_py == m_nat
+        jax.tree.map(
+            lambda x, y: np.testing.assert_array_equal(
+                np.asarray(x), np.asarray(y)
+            ),
+            a_py, a_nat,
+        )
+
+    def test_bfloat16_payload(self, native_lib):
+        import ml_dtypes
+
+        arrays = {"x": np.arange(8).astype(ml_dtypes.bfloat16)}
+        r = encode_rollout(arrays, model_version=0, env_id=0, rollout_id=0,
+                           length=1, total_reward=0.0)
+        _, a = decode_rollout_bytes(r.SerializeToString())
+        assert a["x"].dtype == ml_dtypes.bfloat16
+        np.testing.assert_array_equal(np.asarray(a["x"], np.float32),
+                                      np.arange(8, dtype=np.float32))
+
+    def test_zero_copy_views(self, native_lib):
+        payload = sample_rollout().SerializeToString()
+        _, a = decode_rollout_bytes(payload, native=True)
+        units = a["obs"]["units"]
+        assert units.base is not None  # a view, not an owning copy
+        assert not units.flags.writeable
+
+    def test_malformed_input_falls_back_or_raises_cleanly(self, native_lib):
+        with pytest.raises(Exception):
+            decode_rollout_bytes(b"\xff\xff\xff\xff\x00garbage")
+
+    def test_python_fallback_matches(self):
+        r = sample_rollout(seed=3)
+        payload = r.SerializeToString()
+        m1, a1 = decode_rollout_bytes(payload, native=False)
+        m2, a2 = decode_rollout(r)
+        assert m1 == m2
+        np.testing.assert_array_equal(a1["rewards"], a2["rewards"])

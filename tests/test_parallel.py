@@ -1,0 +1,178 @@
+"""Parallelism-library tests: TP sharding rules on the forced host mesh.
+
+SURVEY.md §2.3 row 3 / VERDICT round 1 item 6: the ``model`` mesh axis must
+do real work. The pin here is GSPMD's semantic transparency: a widened core
+trained on a (1, 2) data×model mesh must produce the same numbers as the
+single-device run.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from dotaclient_tpu.config import MeshConfig, default_config
+from dotaclient_tpu.models import init_params, make_policy
+from dotaclient_tpu.parallel import make_mesh, param_spec, state_shardings
+from dotaclient_tpu.train.ppo import init_train_state, make_train_step
+
+
+def wide_config():
+    cfg = default_config()
+    return dataclasses.replace(
+        cfg,
+        model=dataclasses.replace(cfg.model, hidden_dim=512, dtype="float32"),
+        ppo=dataclasses.replace(cfg.ppo, rollout_len=4, batch_rollouts=4),
+    )
+
+
+def wide_batch(cfg, policy, params, batch=4, seed=0):
+    """Self-consistent batch at the widened-core shapes."""
+    from dotaclient_tpu.models import distributions as D
+    from dotaclient_tpu.train.ppo import example_batch
+
+    rng = np.random.default_rng(seed)
+    T = cfg.ppo.rollout_len
+    b = example_batch(cfg, batch=batch)
+    obs = dict(b["obs"])
+    obs["units"] = jnp.asarray(rng.normal(size=obs["units"].shape).astype(np.float32))
+    obs["globals"] = jnp.asarray(rng.normal(size=obs["globals"].shape).astype(np.float32))
+    b["obs"] = obs
+    b["dones"] = jnp.asarray((rng.random((batch, T)) < 0.1).astype(np.float32))
+    logits, _, _ = policy.apply(params, obs, b["carry0"], b["dones"], method="sequence")
+    logits_t = {k: v[:, :T] for k, v in logits.items()}
+    obs_t = {k: v[:, :T] for k, v in obs.items()}
+    actions, logp = D.sample(jax.random.PRNGKey(seed), logits_t, obs_t)
+    b["actions"] = actions
+    b["behavior_logp"] = logp
+    b["rewards"] = jnp.asarray(rng.normal(size=(batch, T)).astype(np.float32))
+    return b
+
+
+class TestParamSpec:
+    def test_rules(self):
+        cfg = MeshConfig(model_parallel=2, data_parallel=1)
+        mesh = make_mesh(cfg, devices=jax.devices()[:2])
+        # divisible last axis -> sharded on model
+        assert param_spec((128, 512), mesh, cfg) == P(None, "model")
+        assert param_spec((512,), mesh, cfg) == P("model")
+        # indivisible (tiny head) -> replicated
+        assert param_spec((128, 9), mesh, cfg) == P()
+        assert param_spec((1,), mesh, cfg) == P()
+        # scalars -> replicated
+        assert param_spec((), mesh, cfg) == P()
+
+    def test_model_parallel_1_replicates_everything(self):
+        cfg = MeshConfig(model_parallel=1, data_parallel=1)
+        mesh = make_mesh(cfg, devices=jax.devices()[:1])
+        assert param_spec((128, 512), mesh, cfg) == P()
+        assert param_spec((512,), mesh, cfg) == P()
+
+
+class TestSequenceParallel:
+    """Ring / Ulysses attention vs the dense oracle, 8-device sequence
+    sharding (SURVEY.md §2.3 row 5, §7 step 8)."""
+
+    def _qkv(self, B=2, T=32, h=8, d=16, seed=0):
+        rng = np.random.default_rng(seed)
+        mk = lambda: jnp.asarray(rng.normal(size=(B, T, h, d)).astype(np.float32))
+        return mk(), mk(), mk()
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_ring_attention_matches_reference(self, causal):
+        from dotaclient_tpu.parallel.sequence import (
+            make_ring_attention,
+            reference_attention,
+        )
+
+        mesh = make_mesh(MeshConfig(data_parallel=8, model_parallel=1))
+        q, k, v = self._qkv()
+        ring = make_ring_attention(mesh, axis="data", causal=causal)
+        out = jax.device_get(ring(q, k, v))
+        ref = jax.device_get(reference_attention(q, k, v, causal=causal))
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_ulysses_attention_matches_reference(self, causal):
+        from dotaclient_tpu.parallel.sequence import (
+            make_ulysses_attention,
+            reference_attention,
+        )
+
+        mesh = make_mesh(MeshConfig(data_parallel=8, model_parallel=1))
+        q, k, v = self._qkv(seed=3)
+        uly = make_ulysses_attention(mesh, axis="data", causal=causal)
+        out = jax.device_get(uly(q, k, v))
+        ref = jax.device_get(reference_attention(q, k, v, causal=causal))
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    def test_ring_memory_is_sharded(self):
+        """Each device's shard of the output is T/8 of the sequence."""
+        from dotaclient_tpu.parallel.sequence import make_ring_attention
+
+        mesh = make_mesh(MeshConfig(data_parallel=8, model_parallel=1))
+        q, k, v = self._qkv()
+        out = make_ring_attention(mesh, axis="data")(q, k, v)
+        shapes = {s.data.shape for s in out.addressable_shards}
+        assert shapes == {(2, 4, 8, 16)}
+
+
+class TestTensorParallelEquivalence:
+    def test_wide_core_tp2_matches_single_device(self):
+        """hidden=512 policy, one train step: (1 data, 2 model) mesh output
+        must match the 1-device run (same math, different layout)."""
+        base = wide_config()
+        policy = make_policy(base.model, base.obs, base.actions)
+        params = init_params(policy, jax.random.PRNGKey(0))
+        batch = wide_batch(base, policy, params, batch=4, seed=3)
+
+        results = {}
+        for name, mesh_cfg, devs in (
+            ("single", MeshConfig(data_parallel=1, model_parallel=1), 1),
+            ("tp2", MeshConfig(data_parallel=1, model_parallel=2), 2),
+        ):
+            cfg = dataclasses.replace(base, mesh=mesh_cfg)
+            mesh = make_mesh(cfg.mesh, devices=jax.devices()[:devs])
+            state = init_train_state(params, cfg.ppo)
+            step = make_train_step(policy, cfg, mesh)
+            state, metrics = step(state, batch)
+            state, metrics = step(state, batch)
+            results[name] = (
+                jax.device_get(metrics),
+                jax.device_get(state.params),
+            )
+
+        m1, p1 = results["single"]
+        m2, p2 = results["tp2"]
+        for k in m1:
+            np.testing.assert_allclose(
+                m1[k], m2[k], rtol=2e-4, atol=2e-5, err_msg=f"metric {k}"
+            )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5),
+            p1, p2,
+        )
+
+    def test_tp2_state_actually_sharded(self):
+        """The TP path must actually shard parameter leaves over the model
+        axis (not silently replicate)."""
+        base = wide_config()
+        cfg = dataclasses.replace(
+            base, mesh=MeshConfig(data_parallel=1, model_parallel=2)
+        )
+        mesh = make_mesh(cfg.mesh, devices=jax.devices()[:2])
+        policy = make_policy(cfg.model, cfg.obs, cfg.actions)
+        params = init_params(policy, jax.random.PRNGKey(0))
+        state = init_train_state(params, cfg.ppo)
+        step = make_train_step(policy, cfg, mesh)
+        batch = wide_batch(cfg, policy, params, batch=4, seed=0)
+        state, _ = step(state, batch)
+        kernel = state.params["params"]["trunk_proj"]["kernel"]
+        spec = kernel.sharding.spec
+        assert spec == P(None, "model"), f"trunk kernel not TP-sharded: {spec}"
+        # each device holds half the columns
+        shard_shapes = {s.data.shape for s in kernel.addressable_shards}
+        assert shard_shapes == {(kernel.shape[0], kernel.shape[1] // 2)}

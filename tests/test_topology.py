@@ -1,0 +1,300 @@
+"""Multi-process topology tests (SURVEY.md §1: N actor processes → transport
+→ one learner; §5.3: actors are stateless and disposable).
+
+Covers the socket transport's two channels, the AMQP transport against a
+faithful in-memory fake of pika (broker semantics: work queue + fanout
+exchange), and a real two-OS-process integration run with an actor killed
+mid-training — the learner must keep making progress (fault injection the
+reference delegated to k8s restart policies).
+"""
+
+import dataclasses
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from dotaclient_tpu.protos import dota_pb2 as pb
+from dotaclient_tpu.transport import (
+    SocketTransport,
+    TransportServer,
+    encode_rollout,
+    encode_weights,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def tiny_rollout(version=0, rid=0):
+    arrays = {"rewards": np.arange(4, dtype=np.float32) + rid}
+    return encode_rollout(
+        arrays, model_version=version, env_id=0, rollout_id=rid,
+        length=4, total_reward=1.0,
+    )
+
+
+def tiny_weights(version):
+    return encode_weights({"w": np.full((3,), float(version), np.float32)}, version)
+
+
+class TestSocketTransport:
+    def test_rollout_roundtrip_and_weights_fanout(self):
+        server = TransportServer(port=0)
+        try:
+            host, port = server.address
+            a1 = SocketTransport(host, port)
+            a2 = SocketTransport(host, port)
+            for i in range(5):
+                a1.publish_rollout(tiny_rollout(rid=i))
+            deadline = time.time() + 5
+            got = []
+            while len(got) < 5 and time.time() < deadline:
+                got.extend(server.consume_rollouts(16, timeout=0.2))
+            assert sorted(r.rollout_id for r in got) == list(range(5))
+
+            server.publish_weights(tiny_weights(3))
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                w1, w2 = a1.latest_weights(), a2.latest_weights()
+                if w1 is not None and w2 is not None:
+                    break
+                time.sleep(0.02)
+            assert w1.version == 3 and w2.version == 3
+            a1.close(), a2.close()
+        finally:
+            server.close()
+
+    def test_late_joiner_gets_current_weights(self):
+        server = TransportServer(port=0)
+        try:
+            server.publish_weights(tiny_weights(7))
+            host, port = server.address
+            late = SocketTransport(host, port)
+            deadline = time.time() + 5
+            w = None
+            while w is None and time.time() < deadline:
+                w = late.latest_weights()
+                time.sleep(0.02)
+            assert w is not None and w.version == 7
+            late.close()
+        finally:
+            server.close()
+
+    def test_dead_actor_does_not_break_server(self):
+        server = TransportServer(port=0)
+        try:
+            host, port = server.address
+            doomed = SocketTransport(host, port)
+            doomed.publish_rollout(tiny_rollout(rid=1))
+            doomed._sock.close()  # simulate actor crash mid-connection
+            survivor = SocketTransport(host, port)
+            survivor.publish_rollout(tiny_rollout(rid=2))
+            deadline = time.time() + 5
+            ids = set()
+            while len(ids) < 2 and time.time() < deadline:
+                ids |= {
+                    r.rollout_id
+                    for r in server.consume_rollouts(8, timeout=0.2)
+                }
+            assert 2 in ids  # survivor's experience flows after the crash
+            server.publish_weights(tiny_weights(1))  # must not raise
+            survivor.close()
+        finally:
+            server.close()
+
+    def test_actor_side_detects_learner_loss(self):
+        server = TransportServer(port=0)
+        host, port = server.address
+        actor = SocketTransport(host, port)
+        server.close()
+        deadline = time.time() + 5
+        with pytest.raises(ConnectionError):
+            while time.time() < deadline:
+                actor.publish_rollout(tiny_rollout())
+                time.sleep(0.05)
+        actor.close()
+
+
+# ---------------------------------------------------------------------------
+# fake pika: in-memory broker with RMQ work-queue + fanout semantics
+# ---------------------------------------------------------------------------
+
+
+class _FakeBroker:
+    def __init__(self):
+        self.queues = {}
+        self.bindings = {}  # exchange -> [queue names]
+        self._anon = 0
+
+
+class _FakeMethod:
+    def __init__(self, tag, queue=""):
+        self.delivery_tag = tag
+        self.queue = queue
+
+
+class _FakeChannel:
+    def __init__(self, broker):
+        self.b = broker
+        self._tag = 0
+
+    def queue_declare(self, queue="", durable=False, exclusive=False):
+        if not queue:
+            self.b._anon += 1
+            queue = f"amq.gen-{self.b._anon}"
+        self.b.queues.setdefault(queue, [])
+        return types.SimpleNamespace(method=_FakeMethod(0, queue=queue))
+
+    def exchange_declare(self, exchange, exchange_type):
+        self.b.bindings.setdefault(exchange, [])
+
+    def queue_bind(self, exchange, queue):
+        self.b.bindings.setdefault(exchange, []).append(queue)
+
+    def basic_publish(self, exchange, routing_key, body):
+        if exchange:
+            for q in self.b.bindings.get(exchange, []):
+                self.b.queues.setdefault(q, []).append(body)
+        else:
+            self.b.queues.setdefault(routing_key, []).append(body)
+
+    def consume(self, queue, inactivity_timeout=None):
+        while True:
+            q = self.b.queues.get(queue, [])
+            if q:
+                self._tag += 1
+                yield _FakeMethod(self._tag), None, q.pop(0)
+            else:
+                yield None, None, None  # inactivity marker
+
+    def basic_ack(self, delivery_tag):
+        pass
+
+    def cancel(self):
+        pass
+
+    def basic_get(self, queue, auto_ack=False):
+        q = self.b.queues.get(queue, [])
+        if not q:
+            return None, None, None
+        self._tag += 1
+        return _FakeMethod(self._tag), None, q.pop(0)
+
+
+def _install_fake_pika(monkeypatch, broker):
+    fake = types.ModuleType("pika")
+    fake.ConnectionParameters = lambda host, port: (host, port)
+    fake.BlockingConnection = lambda params: types.SimpleNamespace(
+        channel=lambda: _FakeChannel(broker)
+    )
+    monkeypatch.setitem(sys.modules, "pika", fake)
+
+
+class TestAmqpTransportContract:
+    """AmqpTransport against an in-memory broker with pika's call surface —
+    the reference's RMQ topology (work queue + fanout) exercised end to end
+    without a broker (the sandbox has none)."""
+
+    def test_experience_work_queue(self, monkeypatch):
+        from dotaclient_tpu.transport.queues import AmqpTransport
+
+        broker = _FakeBroker()
+        _install_fake_pika(monkeypatch, broker)
+        actor = AmqpTransport("localhost")
+        learner = AmqpTransport("localhost")
+        for i in range(4):
+            actor.publish_rollout(tiny_rollout(rid=i))
+        got = learner.consume_rollouts(10, timeout=0.01)
+        assert sorted(r.rollout_id for r in got) == list(range(4))
+        # work-queue: consumed exactly once
+        assert learner.consume_rollouts(10, timeout=0.01) == []
+
+    def test_weights_fanout_latest_wins(self, monkeypatch):
+        from dotaclient_tpu.transport.queues import AmqpTransport
+
+        broker = _FakeBroker()
+        _install_fake_pika(monkeypatch, broker)
+        a1 = AmqpTransport("localhost")
+        a2 = AmqpTransport("localhost")
+        learner = AmqpTransport("localhost")
+        learner.publish_weights(tiny_weights(1))
+        learner.publish_weights(tiny_weights(2))
+        assert a1.latest_weights().version == 2  # drained to latest
+        assert a2.latest_weights().version == 2  # fanout: every consumer
+        assert a1.latest_weights() is None       # nothing new
+
+
+# ---------------------------------------------------------------------------
+# two-OS-process integration with actor kill
+# ---------------------------------------------------------------------------
+
+
+class TestMultiProcessTopology:
+    def test_learner_survives_actor_kill(self):
+        """Two standalone actor processes feed a socket-transport learner;
+        one is SIGKILLed mid-run; the learner still reaches its step target
+        (stateless-actor fault model, SURVEY.md §5.3)."""
+        from dotaclient_tpu.config import default_config
+        from dotaclient_tpu.train.learner import Learner
+
+        server = TransportServer(port=0)
+        host, port = server.address
+        procs = []
+        try:
+            env = dict(os.environ)
+            env.pop("JAX_PLATFORMS", None)  # actor pins cpu itself
+            for seed in (0, 1):
+                procs.append(
+                    subprocess.Popen(
+                        [
+                            sys.executable, "-m", "dotaclient_tpu.actor",
+                            "--connect", f"{host}:{port}",
+                            "--n-envs", "4", "--seed", str(seed),
+                        ],
+                        cwd=REPO, env=env,
+                        stdout=subprocess.DEVNULL,
+                        stderr=subprocess.DEVNULL,
+                    )
+                )
+
+            config = default_config()
+            config = dataclasses.replace(
+                config,
+                env=dataclasses.replace(config.env, n_envs=4),
+                ppo=dataclasses.replace(
+                    config.ppo, batch_rollouts=8, max_staleness=1_000_000
+                ),
+                buffer=dataclasses.replace(
+                    config.buffer, capacity_rollouts=64, min_fill=8
+                ),
+                log_every=1_000,
+            )
+            learner = Learner(config, transport=server, actor="external")
+
+            result = {}
+
+            def run():
+                result["stats"] = learner.train(8, refresh_every=2)
+
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+            # wait until some progress, then kill one actor
+            deadline = time.time() + 240
+            while learner._host_step < 2 and time.time() < deadline:
+                time.sleep(0.5)
+            assert learner._host_step >= 2, "learner never started stepping"
+            procs[0].kill()
+            t.join(timeout=240)
+            assert not t.is_alive(), "learner stalled after actor kill"
+            assert result["stats"]["optimizer_steps"] >= 8
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            server.close()
