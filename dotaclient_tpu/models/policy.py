@@ -167,9 +167,12 @@ class Policy(nn.Module):
         self, obs: Mapping[str, jnp.ndarray], carry: Carry
     ) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray, Carry]:
         """Single batched step (actor path): obs arrays ``[B, ...]``."""
-        x, unit_emb = self._trunk(obs)
-        carry, y = self.core(carry, x)
-        logits, value = self._heads(y, unit_emb)
+        with jax.named_scope("policy_trunk"):
+            x, unit_emb = self._trunk(obs)
+        with jax.named_scope("policy_core"):
+            carry, y = self.core(carry, x)
+        with jax.named_scope("policy_heads"):
+            logits, value = self._heads(y, unit_emb)
         return logits, value, carry
 
     def sequence(
@@ -214,8 +217,10 @@ class Policy(nn.Module):
             in_axes=1,
             out_axes=1,
         )
-        carry, ys = scan(self.core, carry, (x, resets))           # ys [B, T, H]
-        logits, value = self._heads(ys, unit_emb)
+        with jax.named_scope("policy_core_scan"):
+            carry, ys = scan(self.core, carry, (x, resets))       # ys [B, T, H]
+        with jax.named_scope("policy_heads"):
+            logits, value = self._heads(ys, unit_emb)
         return logits, value, carry
 
     def __call__(self, obs: Mapping[str, jnp.ndarray], carry: Carry):

@@ -1,0 +1,168 @@
+"""Pallas fused LSTM-sequence kernel (the one custom-kernel candidate,
+SURVEY.md §2.2 row 1 / §7 hard-part 1).
+
+Measured context (BASELINE.md "Pallas decision"): at production shapes the
+`nn.scan` LSTM is ~15 µs of a ~55 µs sequence forward and a single-digit
+percent of the 388 µs train step, so the DEFAULT core stays `nn.scan` —
+XLA already fuses the per-step matmul+elementwise well at H=128. This
+kernel exists as the measured alternative for *wider* cores, where keeping
+the weights pinned in VMEM across all T steps pays: one `pallas_call` runs
+the whole sequence, double-reading nothing from HBM.
+
+Cell math (gate order i, f, g, o — pinned by `lstm_sequence_reference`,
+which is both the spec and the fallback):
+
+    gates = x_t @ Wx + h @ Wh + b
+    c' = σ(f)·c + σ(i)·tanh(g);  h' = σ(o)·tanh(c')
+    (h, c) ← (h', c') · (1 - reset_t)   applied BEFORE the step
+
+Gradients: `custom_vjp` with a recompute backward — the forward runs the
+kernel, the backward re-runs the reference under `jax.vjp` (rematerialized
+BPTT; residuals are just the inputs). Numerics parity is tested in
+interpreter mode on CPU and compiled on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas ships with jax; guard anyway for exotic builds
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    HAVE_PALLAS = True
+except ImportError:  # pragma: no cover
+    HAVE_PALLAS = False
+
+
+def _cell(x_t, h, c, wx, wh, b, reset_t):
+    keep = (1.0 - reset_t)[:, None]
+    h = h * keep
+    c = c * keep
+    gates = x_t @ wx + h @ wh + b
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return h, c
+
+
+def lstm_sequence_reference(
+    x: jnp.ndarray,        # f32 [B, T, D]
+    h0: jnp.ndarray,       # f32 [B, H]
+    c0: jnp.ndarray,       # f32 [B, H]
+    wx: jnp.ndarray,       # f32 [D, 4H]
+    wh: jnp.ndarray,       # f32 [H, 4H]
+    b: jnp.ndarray,        # f32 [4H]
+    resets: jnp.ndarray,   # f32 [B, T]
+) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
+    """Spec implementation: plain lax.scan. Returns (hs [B, T, H], (hT, cT))."""
+
+    def step(carry, inp):
+        h, c = carry
+        x_t, r_t = inp
+        h, c = _cell(x_t, h, c, wx, wh, b, r_t)
+        return (h, c), h
+
+    (hT, cT), hs = jax.lax.scan(
+        step, (h0, c0), (jnp.moveaxis(x, 1, 0), jnp.moveaxis(resets, 1, 0))
+    )
+    return jnp.moveaxis(hs, 0, 1), (hT, cT)
+
+
+def _kernel(x_ref, h0_ref, c0_ref, wx_ref, wh_ref, b_ref, r_ref,
+            hs_ref, hT_ref, cT_ref):
+    """Whole-sequence LSTM in one kernel: weights live in VMEM for all T
+    steps; time-major refs so the sequential loop indexes the leading axis."""
+    T = x_ref.shape[0]
+    wx = wx_ref[:]
+    wh = wh_ref[:]
+    b = b_ref[:]
+
+    def body(t, carry):
+        h, c = carry
+        x_t = x_ref[t]
+        r_t = r_ref[t]
+        keep = (1.0 - r_t)[:, None]
+        h = h * keep
+        c = c * keep
+        gates = (
+            jnp.dot(x_t, wx, preferred_element_type=jnp.float32)
+            + jnp.dot(h, wh, preferred_element_type=jnp.float32)
+            + b[None, :]
+        )
+        H = h.shape[-1]
+        i = gates[:, :H]
+        f = gates[:, H:2 * H]
+        g = gates[:, 2 * H:3 * H]
+        o = gates[:, 3 * H:]
+        c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        hs_ref[t] = h
+        return h, c
+
+    h, c = jax.lax.fori_loop(0, T, body, (h0_ref[:], c0_ref[:]))
+    hT_ref[:] = h
+    cT_ref[:] = c
+
+
+def _pallas_forward(x, h0, c0, wx, wh, b, resets, interpret):
+    B, T, D = x.shape
+    H = h0.shape[-1]
+    x_tm = jnp.moveaxis(x, 1, 0)          # [T, B, D]
+    r_tm = jnp.moveaxis(resets, 1, 0)     # [T, B]
+    hs_tm, hT, cT = pl.pallas_call(
+        _kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((T, B, H), jnp.float32),
+            jax.ShapeDtypeStruct((B, H), jnp.float32),
+            jax.ShapeDtypeStruct((B, H), jnp.float32),
+        ),
+        interpret=interpret,
+    )(x_tm, h0, c0, wx, wh, b, r_tm)
+    return jnp.moveaxis(hs_tm, 0, 1), (hT, cT)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7,))
+def lstm_sequence_pallas(x, h0, c0, wx, wh, b, resets, interpret=False):
+    """Fused-kernel LSTM sequence; same contract as the reference."""
+    return _pallas_forward(x, h0, c0, wx, wh, b, resets, interpret)
+
+
+def _fwd(x, h0, c0, wx, wh, b, resets, interpret):
+    out = _pallas_forward(x, h0, c0, wx, wh, b, resets, interpret)
+    return out, (x, h0, c0, wx, wh, b, resets)
+
+
+def _bwd(interpret, residuals, cotangents):
+    # recompute-backward: BPTT through the reference implementation — the
+    # kernel is forward-only, gradients rematerialize in XLA
+    x, h0, c0, wx, wh, b, resets = residuals
+    _, vjp = jax.vjp(
+        lambda x_, h0_, c0_, wx_, wh_, b_: lstm_sequence_reference(
+            x_, h0_, c0_, wx_, wh_, b_, resets
+        ),
+        x, h0, c0, wx, wh, b,
+    )
+    grads = vjp(cotangents)
+    return (*grads, None)  # resets are not differentiated
+
+
+lstm_sequence_pallas.defvjp(_fwd, _bwd)
+
+
+def lstm_sequence(
+    x, h0, c0, wx, wh, b, resets,
+    use_pallas: bool = True,
+    interpret_ok: bool = False,
+) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
+    """Dispatch: fused kernel on TPU; off-TPU the reference scan — the
+    interpreter-mode kernel (Python-emulated, very slow) only when
+    explicitly requested via ``interpret_ok`` (numerics tests)."""
+    on_tpu = jax.default_backend() == "tpu"
+    if not (use_pallas and HAVE_PALLAS) or (not on_tpu and not interpret_ok):
+        return lstm_sequence_reference(x, h0, c0, wx, wh, b, resets)
+    return lstm_sequence_pallas(x, h0, c0, wx, wh, b, resets, not on_tpu)

@@ -1,12 +1,30 @@
-"""Parallelism library: meshes, shardings, SP/TP/PP primitives."""
+"""Parallelism library: meshes, shardings, and the TP/PP/SP primitives.
+
+Coverage vs SURVEY.md §2.3: data parallelism (mesh + batch sharding, grad
+psum), tensor parallelism (``sharding.state_shardings``), pipeline
+parallelism (``pipeline.make_pipeline``), sequence parallelism
+(``sequence``: ring + Ulysses attention). Expert parallelism is deliberately
+absent — the reference has no MoE (SURVEY.md §2.3 row 6); an EP axis would
+slot into ``MeshConfig`` + a shard_map'd expert dispatch the same way the
+primitives here do.
+"""
 
 from dotaclient_tpu.parallel.mesh import data_sharding, make_mesh, replicated
+from dotaclient_tpu.parallel.pipeline import make_pipeline, stack_stage_params
+from dotaclient_tpu.parallel.sequence import (
+    make_ring_attention,
+    make_ulysses_attention,
+)
 from dotaclient_tpu.parallel.sharding import param_spec, state_shardings
 
 __all__ = [
     "data_sharding",
     "make_mesh",
+    "make_pipeline",
+    "make_ring_attention",
+    "make_ulysses_attention",
     "param_spec",
     "replicated",
+    "stack_stage_params",
     "state_shardings",
 ]

@@ -1,0 +1,8 @@
+"""Version-compat shims shared by the parallelism modules."""
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+__all__ = ["shard_map"]

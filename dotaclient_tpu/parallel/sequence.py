@@ -26,7 +26,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from dotaclient_tpu.parallel._compat import shard_map
 
 AXIS = "data"  # default mesh axis to shard the sequence over
 

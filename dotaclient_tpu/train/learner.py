@@ -69,6 +69,7 @@ class Learner:
         seed: int = 0,
         vec: bool = True,
         actor: Optional[str] = None,
+        debug_checkify: bool = False,
     ) -> None:
         # actor mode: "device" (on-device rollout scan — fastest, default for
         # training runs), "vec" (numpy vectorized sim, host-driven), "scalar"
@@ -96,7 +97,9 @@ class Learner:
             self.ckpt = CheckpointManager(checkpoint_dir)
             if restore and self.ckpt.latest_step() is not None:
                 self.state, _ = self.ckpt.restore(config, self.state)
-        self.train_step = make_train_step(self.policy, config, self.mesh)
+        self.train_step = make_train_step(
+            self.policy, config, self.mesh, debug_checkify=debug_checkify
+        )
         self.buffer = TrajectoryBuffer(config, self.mesh)
         self.transport = transport or InProcTransport()
         # Vectorized mode ships decoded rollouts through an in-proc deque
@@ -486,6 +489,16 @@ def main(argv=None) -> Dict[str, float]:
         "--refresh-every", type=int, default=10,
         help="publish weights to actors every N optimizer steps",
     )
+    p.add_argument(
+        "--profile", type=str, default=None,
+        help="capture a jax.profiler device trace of the run to this logdir "
+        "(view with tensorboard)",
+    )
+    p.add_argument(
+        "--checkify", action="store_true",
+        help="debug numerics: checkify-instrumented train step that raises "
+        "on the first NaN/Inf (slow; never for production runs)",
+    )
     args = p.parse_args(argv)
     if args.transport != "inproc" and args.actor is None:
         args.actor = "external"
@@ -537,10 +550,14 @@ def main(argv=None) -> Dict[str, float]:
         restore=args.restore,
         seed=args.seed,
         actor=args.actor or ("scalar" if args.no_vec else "device"),
+        debug_checkify=args.checkify,
     )
-    stats = learner.train(
-        args.steps, overlap=args.overlap, refresh_every=args.refresh_every
-    )
+    from dotaclient_tpu.utils.profiling import trace
+
+    with trace(args.profile):
+        stats = learner.train(
+            args.steps, overlap=args.overlap, refresh_every=args.refresh_every
+        )
     print(
         f"done: {stats['optimizer_steps']:.0f} steps, "
         f"{stats['frames_trained']:.0f} frames, "

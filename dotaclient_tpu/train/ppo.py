@@ -155,6 +155,7 @@ def make_train_step(
     policy: Policy,
     config: RunConfig,
     mesh: Mesh,
+    debug_checkify: bool = False,
 ):
     """Compile the train step against ``mesh``.
 
@@ -181,6 +182,25 @@ def make_train_step(
     )
     state_sharding = state_shardings(state_shape, mesh, config.mesh)
     metrics_repl = repl
+    if debug_checkify:
+        # Debug numerics mode (SURVEY.md §5.2): checkify float checks guard
+        # every op and RAISE on the first NaN/Inf instead of letting it
+        # propagate into the params. No donation, no sharding constraints —
+        # this is the hunt-the-NaN path, not the production path.
+        from jax.experimental import checkify
+
+        inner = checkify.checkify(
+            lambda state, batch: _train_step(policy, config.ppo, state, batch),
+            errors=checkify.float_checks,
+        )
+        jitted = jax.jit(inner)
+
+        def checked_step(state, batch):
+            err, out = jitted(state, batch)
+            checkify.check_error(err)
+            return out
+
+        return checked_step
     step_fn = jax.jit(
         lambda state, batch: _train_step(policy, config.ppo, state, batch),
         in_shardings=(state_sharding, batch_shardings),

@@ -1,0 +1,40 @@
+"""Device tracing and debug-mode numerics checking.
+
+SURVEY.md §5.1-5.2: the reference had wall-clock prints and TensorBoard
+scalars only; the rebuild's observability is the JAX toolchain — profiler
+traces viewable in TensorBoard (tensorboard-plugin-profile) and
+`checkify`-instrumented train steps for NaN/Inf hunting.
+
+Usage:
+    with trace("runs/profile"):           # device trace of the block
+        learner.train(100)
+
+    python -m dotaclient_tpu.train.learner --profile runs/profile
+    python -m dotaclient_tpu.train.learner --checkify   # debug numerics
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: Optional[str]) -> Iterator[None]:
+    """jax.profiler device trace over the enclosed block (no-op when
+    ``logdir`` is None). View: tensorboard --logdir <logdir>."""
+    if logdir is None:
+        yield
+        return
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+# The checkify-instrumented train step lives in train/ppo.py
+# (make_train_step(debug_checkify=True)); named scopes are applied directly
+# at the policy's phase boundaries (models/policy.py).

@@ -120,6 +120,51 @@ class TestSequenceParallel:
         assert shapes == {(2, 4, 8, 16)}
 
 
+class TestPipelineParallel:
+    """GPipe-style stage pipeline vs sequential application (SURVEY.md §2.3
+    row 4, §7 step 8)."""
+
+    def test_pipeline_matches_sequential(self):
+        import flax.linen as nn
+        from dotaclient_tpu.parallel.pipeline import (
+            make_pipeline,
+            stack_stage_params,
+        )
+
+        S, M, B, D = 4, 8, 32, 64
+        mesh = make_mesh(
+            MeshConfig(data_parallel=1, model_parallel=S,
+                       model_axis="stage", data_axis="data"),
+            devices=jax.devices()[:S],
+        )
+
+        class Block(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return x + nn.Dense(D)(nn.tanh(x))
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(B, D)).astype(np.float32))
+        block = Block()
+        params_list = [
+            block.init(jax.random.PRNGKey(s), x) for s in range(S)
+        ]
+        stacked = stack_stage_params(params_list)
+
+        pipe = make_pipeline(
+            lambda p, a: block.apply(p, a), mesh, axis="stage",
+            n_microbatches=M,
+        )
+        out = pipe(stacked, x)
+
+        ref = x
+        for p in params_list:
+            ref = block.apply(p, ref)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+
 class TestTensorParallelEquivalence:
     def test_wide_core_tp2_matches_single_device(self):
         """hidden=512 policy, one train step: (1 data, 2 model) mesh output
