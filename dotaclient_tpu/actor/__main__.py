@@ -34,7 +34,9 @@ def main(argv=None) -> int:
     p.add_argument("--n-envs", type=int, default=64)
     p.add_argument("--opponent", type=str, default="scripted_easy")
     p.add_argument("--team-size", type=int, default=1)
-    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--seed", type=int, default=None,
+                   help="rollout RNG seed; default derives from $POD_NAME "
+                        "(unique per k8s replica) or 0 outside k8s")
     p.add_argument("--steps", type=int, default=0,
                    help="stop after N env steps (0 = run forever)")
     p.add_argument("--refresh-every", type=int, default=8,
@@ -45,6 +47,15 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     if bool(args.connect) == bool(args.amqp):
         p.error("exactly one of --connect or --amqp is required")
+    if args.seed is None:
+        # Replicated actor fleets must not stream identical experience: the
+        # k8s manifest injects POD_NAME, and each replica hashes its unique
+        # pod name into its seed — no coordination needed.
+        import os
+        import zlib
+
+        pod = os.environ.get("POD_NAME", "")
+        args.seed = zlib.crc32(pod.encode()) & 0x7FFFFFFF if pod else 0
 
     import jax
 

@@ -57,6 +57,9 @@ class ModelConfig:
     n_layers: int = 2
     n_heads: int = 4
     context_window: int = 16     # rolling KV-cache length (recurrent carry)
+    # Mixture-of-experts FFN (expert parallelism; 0 = dense MLP).
+    moe_experts: int = 0         # experts per MoE layer, sharded over `model`
+    moe_capacity_factor: float = 2.0
     dtype: str = "bfloat16"      # compute dtype; params stay float32
     param_dtype: str = "float32"
 

@@ -1,0 +1,95 @@
+"""Mixture-of-experts MLP with expert parallelism via sharding annotations.
+
+The reference has no MoE (SURVEY.md §2.3 row 6 — EP listed as "not needed
+for parity; stub"); the rebuild ships it as a real, first-class option so
+the transformer core (SURVEY.md §7 step 8) can widen FFN capacity without
+widening per-token FLOPs. Two implementations cover the two idiomatic ways
+to do EP on TPU:
+
+* **This module** — the GSPMD path used *inside the policy*: Switch-style
+  top-1 gating with capacity, einsum dispatch/combine, expert-major weights
+  ``[E, D, F]``. The expert-major parameters are sharded over the mesh's
+  ``model`` axis (``parallel.sharding`` path rule: any param path containing
+  ``"expert"`` → ``P(model, ...)``); under ``jit`` GSPMD propagates that
+  layout through the einsums and emits the all-to-alls itself — no
+  hand-written communication (SURVEY.md §5.8 design rule).
+* ``dotaclient_tpu.parallel.expert`` — the explicit ``shard_map`` +
+  ``all_to_all`` primitive, the library-level EP analogue of the ring/
+  Ulysses SP modules, with an oracle equivalence test.
+
+Capacity semantics: each expert processes at most ``C = ceil(tokens/E ·
+capacity_factor)`` tokens per call; overflow tokens are *dropped* (their
+FFN delta is zero, the residual passes through) — the standard Switch
+trade for static shapes, which is exactly what XLA needs (SURVEY.md §7
+hard-part 5: fixed-shape discipline).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from dotaclient_tpu.config import ModelConfig
+from dotaclient_tpu.parallel.expert import expert_capacity, route_top1
+
+
+def _dtype(name: str):
+    return {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[name]
+
+
+class MoEMLP(nn.Module):
+    """Top-1 (Switch) routed MLP: ``[B, D] -> [B, D]``.
+
+    Parameters are expert-major (``w1 [E, D, F]``, ``w2 [E, F, D]``) so the
+    expert axis is shardable; ``dotaclient_tpu.parallel.sharding`` maps any
+    parameter path containing ``"expert"`` to ``P(model, ...)``.
+    """
+
+    config: ModelConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        dtype, pdtype = _dtype(cfg.dtype), _dtype(cfg.param_dtype)
+        B, D = x.shape
+        E = cfg.moe_experts
+        F = 4 * cfg.hidden_dim
+        C = expert_capacity(B, E, cfg.moe_capacity_factor)
+
+        gate_w = self.param(
+            "gate", nn.initializers.lecun_normal(), (D, E), pdtype
+        )
+        w1 = self.param(
+            "expert_w1",
+            nn.initializers.lecun_normal(batch_axis=(0,)),
+            (E, D, F),
+            pdtype,
+        )
+        b1 = self.param("expert_b1", nn.initializers.zeros, (E, F), pdtype)
+        w2 = self.param(
+            "expert_w2",
+            nn.initializers.lecun_normal(batch_axis=(0,)),
+            (E, F, D),
+            pdtype,
+        )
+        b2 = self.param("expert_b2", nn.initializers.zeros, (E, D), pdtype)
+
+        # -- route: top-1 expert per token, capacity-limited ---------------
+        # (shared routing math with the explicit shard_map EP form)
+        dispatch, combine, probs = route_top1(x, gate_w, E, C)  # [B, E, C]
+
+        # -- dispatch → expert FFN → combine (all einsum: GSPMD partitions
+        # the E axis over the model-mesh axis and inserts the all-to-alls)
+        xin = jnp.einsum("bec,bd->ecd", dispatch.astype(dtype), x.astype(dtype))
+        h = jnp.einsum("ecd,edf->ecf", xin, w1.astype(dtype)) + b1[:, None].astype(dtype)
+        h = nn.gelu(h)
+        out = jnp.einsum("ecf,efd->ecd", h, w2.astype(dtype)) + b2[:, None].astype(dtype)
+        y = jnp.einsum("bec,ecd->bd", combine.astype(dtype), out)
+
+        # aux load-balancing loss (Switch eq. 4): mean gate prob × mean
+        # token fraction per expert, scaled by E — stored for the learner
+        # to pick up via mutable "losses" collection when it cares
+        frac = dispatch.sum(axis=2).mean(axis=0)   # kept-token fraction / expert
+        imp = probs.mean(axis=0)
+        self.sow("losses", "moe_aux", E * jnp.sum(frac * imp))
+        return y.astype(x.dtype)

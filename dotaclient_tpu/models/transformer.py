@@ -22,6 +22,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from dotaclient_tpu.config import ModelConfig
+from dotaclient_tpu.models.moe import MoEMLP
 
 
 def _dtype(name: str):
@@ -64,9 +65,14 @@ class _Block(nn.Module):
         h = h + nn.Dense(H, dtype=dtype, param_dtype=pdtype, name="o")(out)
 
         hm = nn.LayerNorm(dtype=dtype, param_dtype=pdtype)(h)
-        hm = nn.Dense(4 * H, dtype=dtype, param_dtype=pdtype)(hm)
-        hm = nn.gelu(hm)
-        h = h + nn.Dense(H, dtype=dtype, param_dtype=pdtype)(hm)
+        if cfg.moe_experts > 0:
+            # routed-FFN option: per-token top-1 expert, expert weights
+            # sharded over the `model` mesh axis (models/moe.py)
+            h = h + MoEMLP(cfg, name="moe")(hm)
+        else:
+            hm = nn.Dense(4 * H, dtype=dtype, param_dtype=pdtype)(hm)
+            hm = nn.gelu(hm)
+            h = h + nn.Dense(H, dtype=dtype, param_dtype=pdtype)(hm)
 
         # roll the window: drop oldest, append this step (f32 cache — the
         # carry crosses the wire/buffer in f32 like the LSTM state)

@@ -3,12 +3,15 @@
 Coverage vs SURVEY.md §2.3: data parallelism (mesh + batch sharding, grad
 psum), tensor parallelism (``sharding.state_shardings``), pipeline
 parallelism (``pipeline.make_pipeline``), sequence parallelism
-(``sequence``: ring + Ulysses attention). Expert parallelism is deliberately
-absent — the reference has no MoE (SURVEY.md §2.3 row 6); an EP axis would
-slot into ``MeshConfig`` + a shard_map'd expert dispatch the same way the
-primitives here do.
+(``sequence``: ring + Ulysses attention), and expert parallelism
+(``expert``: shard_map + all_to_all Switch dispatch; the GSPMD einsum form
+lives in ``models.moe`` and shards via the ``"expert"`` path rule in
+``sharding``). The reference has none of TP/PP/SP/EP (its core is an
+LSTM(128) on one GPU); the rebuild ships them first-class per SURVEY.md §7
+step 8.
 """
 
+from dotaclient_tpu.parallel.expert import make_expert_dispatch
 from dotaclient_tpu.parallel.mesh import data_sharding, make_mesh, replicated
 from dotaclient_tpu.parallel.pipeline import make_pipeline, stack_stage_params
 from dotaclient_tpu.parallel.sequence import (
@@ -19,6 +22,7 @@ from dotaclient_tpu.parallel.sharding import param_spec, state_shardings
 
 __all__ = [
     "data_sharding",
+    "make_expert_dispatch",
     "make_mesh",
     "make_pipeline",
     "make_ring_attention",

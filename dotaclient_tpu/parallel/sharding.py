@@ -7,10 +7,19 @@ choice: annotate the parameter (and matching optimizer-state) leaves with a
 PartitionSpec and XLA emits the all-gathers/reduce-scatters over ICI; the
 math is unchanged, which the 1-vs-N equivalence test pins down.
 
-Rule (Megatron-style column sharding, applied uniformly): any parameter
-whose LAST axis is divisible by the model-axis size is sharded on that axis
-(Dense/LSTM-gate kernels ``[in, out]`` and their biases, embedding tables
-``[vocab, dim]``); everything else — tiny heads, scalars — is replicated.
+Rules, in precedence order:
+
+1. **Expert parallelism**: any parameter whose tree path contains
+   ``"expert"`` (the MoE expert-major tensors ``[E, ...]`` of
+   ``models/moe.py``) is sharded on its FIRST axis over the model axis —
+   each device holds ``E/n`` whole experts; GSPMD turns the dispatch/
+   combine einsums into all-to-alls.
+2. **Megatron-style column sharding**, applied uniformly: any parameter
+   whose LAST axis is divisible by the model-axis size is sharded on that
+   axis (Dense/LSTM-gate kernels ``[in, out]`` and their biases, embedding
+   tables ``[vocab, dim]``).
+3. Everything else — tiny heads, scalars — is replicated.
+
 With ``model_parallel == 1`` every leaf is replicated and behavior is
 bit-identical to the data-parallel-only path.
 """
@@ -25,11 +34,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from dotaclient_tpu.config import MeshConfig
 
 
-def param_spec(shape, mesh: Mesh, config: MeshConfig) -> P:
+def param_spec(shape, mesh: Mesh, config: MeshConfig, path: str = "") -> P:
     """PartitionSpec for one parameter leaf under the model axis."""
     model = config.model_axis
     n = mesh.shape[model]
-    if n > 1 and len(shape) >= 1 and shape[-1] % n == 0 and shape[-1] >= n:
+    if n <= 1:
+        return P()
+    if "expert" in path and len(shape) >= 1 and shape[0] % n == 0:
+        return P(model, *((None,) * (len(shape) - 1)))
+    if len(shape) >= 1 and shape[-1] % n == 0 and shape[-1] >= n:
         return P(*((None,) * (len(shape) - 1)), model)
     return P()
 
@@ -39,8 +52,9 @@ def state_shardings(state: Any, mesh: Mesh, config: MeshConfig) -> Any:
     params and Adam's mu/nu mirrors) follow :func:`param_spec`; scalars and
     counters replicate."""
 
-    def leaf_sharding(leaf) -> NamedSharding:
+    def leaf_sharding(path, leaf) -> NamedSharding:
         shape = getattr(leaf, "shape", ())
-        return NamedSharding(mesh, param_spec(shape, mesh, config))
+        name = jax.tree_util.keystr(path)
+        return NamedSharding(mesh, param_spec(shape, mesh, config, name))
 
-    return jax.tree.map(leaf_sharding, state)
+    return jax.tree_util.tree_map_with_path(leaf_sharding, state)

@@ -30,7 +30,7 @@ class TestPallasLSTM:
     def test_forward_parity(self):
         args = inputs()
         hs_r, (hT_r, cT_r) = lstm_sequence_reference(*args)
-        hs_p, (hT_p, cT_p) = lstm_sequence(*args)
+        hs_p, (hT_p, cT_p) = lstm_sequence(*args, interpret_ok=True)
         np.testing.assert_allclose(np.asarray(hs_r), np.asarray(hs_p),
                                    rtol=1e-5, atol=1e-6)
         np.testing.assert_allclose(np.asarray(hT_r), np.asarray(hT_p),
@@ -42,8 +42,8 @@ class TestPallasLSTM:
         """A reset at step t must make steps ≥ t independent of the carry."""
         x, h0, c0, wx, wh, b, _ = inputs(reset_p=0.0)
         resets = jnp.zeros(x.shape[:2], jnp.float32).at[:, 3].set(1.0)
-        hs_a, _ = lstm_sequence(x, h0, c0, wx, wh, b, resets)
-        hs_b, _ = lstm_sequence(x, 17.0 + h0, c0 - 5.0, wx, wh, b, resets)
+        hs_a, _ = lstm_sequence(x, h0, c0, wx, wh, b, resets, interpret_ok=True)
+        hs_b, _ = lstm_sequence(x, 17.0 + h0, c0 - 5.0, wx, wh, b, resets, interpret_ok=True)
         assert not np.allclose(np.asarray(hs_a[:, 0]), np.asarray(hs_b[:, 0]))
         np.testing.assert_allclose(
             np.asarray(hs_a[:, 3:]), np.asarray(hs_b[:, 3:]),
@@ -59,7 +59,10 @@ class TestPallasLSTM:
                 return (hs ** 2).sum() + (hT * cT).sum()
             return inner
 
-        g_p = jax.grad(loss(lstm_sequence), argnums=(0, 1, 2))(wx, wh, b)
+        g_p = jax.grad(
+            loss(lambda *a: lstm_sequence(*a, interpret_ok=True)),
+            argnums=(0, 1, 2),
+        )(wx, wh, b)
         g_r = jax.grad(loss(lstm_sequence_reference), argnums=(0, 1, 2))(wx, wh, b)
         for a, r in zip(g_p, g_r):
             np.testing.assert_allclose(
