@@ -40,19 +40,27 @@ class TrajectoryBuffer:
     def __init__(self, config: RunConfig, mesh: Mesh) -> None:
         self.config = config
         self.mesh = mesh
-        n_data = mesh.shape[config.mesh.data_axis]
+        from dotaclient_tpu.parallel.mesh import batch_axes, data_sharding
+
+        axes = batch_axes(mesh, config.mesh)
+        n_data = 1
+        for a in axes:
+            n_data *= mesh.shape[a]
+        desc = "×".join(f"{a}={mesh.shape[a]}" for a in axes)
         cap = config.buffer.capacity_rollouts
         if cap % n_data:
             raise ValueError(
-                f"buffer capacity {cap} not divisible by data-parallel size {n_data}"
+                f"buffer capacity {cap} not divisible by the batch shard "
+                f"count {n_data} ({desc})"
             )
         if config.ppo.batch_rollouts % n_data:
             raise ValueError(
-                f"batch_rollouts {config.ppo.batch_rollouts} not divisible by "
-                f"data-parallel size {n_data} (batches are data-sharded)"
+                f"batch_rollouts {config.ppo.batch_rollouts} not divisible "
+                f"by the batch shard count {n_data} ({desc}; batches are "
+                f"sharded over these axes)"
             )
         self.capacity = cap
-        self._sharding = NamedSharding(mesh, P(config.mesh.data_axis))
+        self._sharding = data_sharding(mesh, config.mesh)
         template = example_batch(config, batch=cap)
         self._store = jax.tree.map(
             lambda x: jax.device_put(x, self._sharding), template

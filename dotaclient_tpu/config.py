@@ -92,12 +92,15 @@ class EnvConfig:
 
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
-    """Device mesh layout. Axes: data (batch/grad psum), model (TP)."""
+    """Device mesh layout. Axes: dcn (multi-slice), data (batch/grad psum),
+    model (TP). With ``dcn_slices == 1`` the mesh is 2-D (data, model)."""
 
     data_axis: str = "data"
     model_axis: str = "model"
+    dcn_axis: str = "dcn"
     data_parallel: int = -1      # -1 => all remaining devices
     model_parallel: int = 1
+    dcn_slices: int = 1          # ICI-connected slices bridged over DCN
 
 
 @dataclasses.dataclass(frozen=True)

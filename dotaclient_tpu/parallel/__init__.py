@@ -11,8 +11,17 @@ LSTM(128) on one GPU); the rebuild ships them first-class per SURVEY.md §7
 step 8.
 """
 
+from dotaclient_tpu.parallel.distributed import (
+    initialize_runtime,
+    process_info,
+)
 from dotaclient_tpu.parallel.expert import make_expert_dispatch
-from dotaclient_tpu.parallel.mesh import data_sharding, make_mesh, replicated
+from dotaclient_tpu.parallel.mesh import (
+    batch_axes,
+    data_sharding,
+    make_mesh,
+    replicated,
+)
 from dotaclient_tpu.parallel.pipeline import make_pipeline, stack_stage_params
 from dotaclient_tpu.parallel.sequence import (
     make_ring_attention,
@@ -21,8 +30,11 @@ from dotaclient_tpu.parallel.sequence import (
 from dotaclient_tpu.parallel.sharding import param_spec, state_shardings
 
 __all__ = [
+    "batch_axes",
     "data_sharding",
+    "initialize_runtime",
     "make_expert_dispatch",
+    "process_info",
     "make_mesh",
     "make_pipeline",
     "make_ring_attention",

@@ -6,14 +6,22 @@ a `jax.sharding.Mesh` and XLA emits the collectives over ICI/DCN
 (SURVEY.md §2.4, §5.8) — no hand-written communication.
 
 Axes:
-  * ``data``  — batch dimension; gradients psum over it.
+  * ``dcn``   — multi-slice axis: each index is one ICI-connected TPU slice;
+    traffic over this axis rides the data-center network. Present only when
+    ``dcn_slices > 1``.
+  * ``data``  — batch dimension; gradients psum over it (and over ``dcn``
+    when present — XLA lowers that to the hierarchical pattern:
+    reduce-scatter/all-gather over ICI inside each slice, a slice-count
+    all-reduce over DCN between them).
   * ``model`` — tensor-parallel axis for widened cores (unused at LSTM(128)
-    scale but first-class per SURVEY.md §2.3).
+    scale but first-class per SURVEY.md §2.3). TP collectives must stay on
+    ICI, so the model axis is always innermost (fastest-varying device
+    order) and never crosses a slice boundary.
 """
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -25,27 +33,50 @@ from dotaclient_tpu.config import MeshConfig
 def make_mesh(
     config: MeshConfig, devices: Optional[Sequence[jax.Device]] = None
 ) -> Mesh:
-    """Build a (data, model) mesh over ``devices`` (default: all)."""
+    """Build a (data, model) — or (dcn, data, model) — mesh over
+    ``devices`` (default: all).
+
+    Device order: JAX's ``jax.devices()`` enumerates multi-slice systems
+    slice-major (all of slice 0, then slice 1, ...), so reshaping to
+    ``(dcn, data, model)`` puts each slice's devices in one dcn index and
+    keeps the model axis on ICI neighbors.
+    """
     devices = list(devices if devices is not None else jax.devices())
     model = max(1, config.model_parallel)
-    if len(devices) % model:
+    dcn = max(1, config.dcn_slices)
+    if len(devices) % (model * dcn):
         raise ValueError(
-            f"{len(devices)} devices not divisible by model_parallel={model}"
+            f"{len(devices)} devices not divisible by "
+            f"dcn_slices×model_parallel={dcn}x{model}"
         )
     data = config.data_parallel
     if data == -1:
-        data = len(devices) // model
-    if data * model != len(devices):
+        data = len(devices) // (model * dcn)
+    if dcn * data * model != len(devices):
         raise ValueError(
-            f"mesh {data}x{model} != {len(devices)} devices"
+            f"mesh {dcn}x{data}x{model} != {len(devices)} devices"
+        )
+    if dcn > 1:
+        arr = np.asarray(devices).reshape(dcn, data, model)
+        return Mesh(
+            arr, (config.dcn_axis, config.data_axis, config.model_axis)
         )
     arr = np.asarray(devices).reshape(data, model)
     return Mesh(arr, (config.data_axis, config.model_axis))
 
 
+def batch_axes(mesh: Mesh, config: MeshConfig) -> Tuple[str, ...]:
+    """Mesh axes the batch dimension shards over: (dcn?, data)."""
+    axes = []
+    if config.dcn_axis in mesh.shape:
+        axes.append(config.dcn_axis)
+    axes.append(config.data_axis)
+    return tuple(axes)
+
+
 def data_sharding(mesh: Mesh, config: MeshConfig) -> NamedSharding:
-    """Batch-sharded over the data axis (leading dimension)."""
-    return NamedSharding(mesh, P(config.data_axis))
+    """Batch-sharded over the (dcn×)data axes (leading dimension)."""
+    return NamedSharding(mesh, P(batch_axes(mesh, config)))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -499,11 +499,37 @@ def main(argv=None) -> Dict[str, float]:
         help="debug numerics: checkify-instrumented train step that raises "
         "on the first NaN/Inf (slow; never for production runs)",
     )
+    p.add_argument(
+        "--multihost", action="store_true",
+        help="join the job-wide JAX distributed runtime before any device "
+        "op (TPU pods/GKE auto-detect coordinator); required on every host "
+        "of a multi-host or multi-slice (--dcn-slices > 1) job",
+    )
+    p.add_argument("--dcn-slices", type=int, default=None,
+                   help="ICI-connected slices bridged over DCN (mesh axis)")
+    p.add_argument("--model-parallel", type=int, default=None,
+                   help="tensor-parallel width (model mesh axis)")
     args = p.parse_args(argv)
     if args.transport != "inproc" and args.actor is None:
         args.actor = "external"
 
+    if args.multihost:
+        # must precede every jax op in this process
+        from dotaclient_tpu.parallel import initialize_runtime, process_info
+
+        initialize_runtime()
+        print(f"learner: distributed runtime up: {process_info()}", flush=True)
+
     config = default_config()
+    mesh_over = {}
+    if args.dcn_slices is not None:
+        mesh_over["dcn_slices"] = args.dcn_slices
+    if args.model_parallel is not None:
+        mesh_over["model_parallel"] = args.model_parallel
+    if mesh_over:
+        config = dataclasses.replace(
+            config, mesh=dataclasses.replace(config.mesh, **mesh_over)
+        )
     if args.smoke:
         config = dataclasses.replace(
             config,

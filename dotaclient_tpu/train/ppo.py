@@ -170,7 +170,12 @@ def make_train_step(
     from dotaclient_tpu.models import init_params
     from dotaclient_tpu.parallel.sharding import state_shardings
 
-    data_sharding = NamedSharding(mesh, P(config.mesh.data_axis))
+    from dotaclient_tpu.parallel.mesh import data_sharding as _data_sharding
+
+    # (dcn, data) when the mesh is multi-slice, else just (data,): the
+    # gradient all-reduce then lowers hierarchically — ICI inside each
+    # slice, one slice-level all-reduce over DCN
+    data_sharding = _data_sharding(mesh, config.mesh)
     repl = NamedSharding(mesh, P())
     batch_shardings = jax.tree.map(
         lambda _: data_sharding, example_batch(config, batch=1, as_struct=True)

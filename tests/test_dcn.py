@@ -1,0 +1,123 @@
+"""Multi-slice (DCN) mesh tests on the forced 8-device host platform.
+
+SURVEY.md §7 step 8 ("multi-slice DCN mesh") and §5.8: across slices the
+batch/gradient traffic crosses the ``dcn`` mesh axis; GSPMD's math must be
+invariant to how the devices are factored. The pin mirrors the 1-vs-8
+data-parallel golden test: one train step on a (dcn=2, data=2, model=2)
+mesh must equal the same step on the flat (data=4, model=2) mesh.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from dotaclient_tpu.config import MeshConfig, default_config
+from dotaclient_tpu.models import init_params, make_policy
+from dotaclient_tpu.parallel import batch_axes, data_sharding, make_mesh
+from dotaclient_tpu.train.ppo import (
+    example_batch,
+    init_train_state,
+    make_train_step,
+)
+
+
+def small_cfg(mesh: MeshConfig):
+    cfg = default_config()
+    return dataclasses.replace(
+        cfg,
+        model=dataclasses.replace(cfg.model, dtype="float32"),
+        ppo=dataclasses.replace(cfg.ppo, rollout_len=4, batch_rollouts=8),
+        mesh=mesh,
+    )
+
+
+class TestDcnMesh:
+    def test_mesh_shape_and_batch_axes(self):
+        mc = MeshConfig(dcn_slices=2, model_parallel=2, data_parallel=-1)
+        mesh = make_mesh(mc, devices=jax.devices()[:8])
+        assert dict(mesh.shape) == {"dcn": 2, "data": 2, "model": 2}
+        assert batch_axes(mesh, mc) == ("dcn", "data")
+        assert data_sharding(mesh, mc).spec == P(("dcn", "data"))
+
+    def test_flat_mesh_has_no_dcn_axis(self):
+        mc = MeshConfig()
+        mesh = make_mesh(mc, devices=jax.devices()[:8])
+        assert batch_axes(mesh, mc) == ("data",)
+
+    def test_indivisible_raises(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            make_mesh(
+                MeshConfig(dcn_slices=3), devices=jax.devices()[:8]
+            )
+
+    def test_train_step_dcn_equals_flat(self):
+        """(dcn=2, data=2, model=2) ≡ (data=4, model=2): same devices, same
+        math, different factorization — losses must match to fp tolerance."""
+        flat_cfg = small_cfg(MeshConfig(model_parallel=2, data_parallel=-1))
+        dcn_cfg = small_cfg(
+            MeshConfig(dcn_slices=2, model_parallel=2, data_parallel=-1)
+        )
+        policy = make_policy(flat_cfg.model, flat_cfg.obs, flat_cfg.actions)
+        params = init_params(policy, jax.random.PRNGKey(0))
+
+        losses = {}
+        for name, cfg in (("flat", flat_cfg), ("dcn", dcn_cfg)):
+            mesh = make_mesh(cfg.mesh, devices=jax.devices()[:8])
+            state = init_train_state(params, cfg.ppo)
+            step = make_train_step(policy, cfg, mesh)
+            batch = example_batch(cfg, batch=cfg.ppo.batch_rollouts)
+            state, metrics = step(state, batch)
+            # one more step so optimizer-state divergence would also show
+            _, metrics = step(state, batch)
+            losses[name] = float(np.asarray(metrics["loss"]))
+        assert np.isfinite(losses["flat"])
+        np.testing.assert_allclose(losses["flat"], losses["dcn"], rtol=1e-5)
+
+    def test_buffer_shards_over_dcn_and_data(self):
+        from dotaclient_tpu.buffer.trajectory_buffer import TrajectoryBuffer
+
+        cfg = small_cfg(
+            MeshConfig(dcn_slices=2, model_parallel=1, data_parallel=-1)
+        )
+        cfg = dataclasses.replace(
+            cfg,
+            buffer=dataclasses.replace(
+                cfg.buffer, capacity_rollouts=16, min_fill=8
+            ),
+        )
+        mesh = make_mesh(cfg.mesh, devices=jax.devices()[:8])
+        buf = TrajectoryBuffer(cfg, mesh)
+        leaf = jax.tree.leaves(buf._store)[0]
+        assert leaf.sharding.spec == P(("dcn", "data"))
+
+
+class TestInitializeRuntime:
+    def test_single_process_idempotent(self):
+        """Must run in a process that has not touched a backend yet (the
+        production constraint), so: fresh subprocess, init twice, report."""
+        import json
+        import os
+        import subprocess
+        import sys
+
+        port = 20000 + os.getpid() % 20000   # concurrent runs must not collide
+        code = (
+            "import json\n"
+            "from dotaclient_tpu.parallel import initialize_runtime, process_info\n"
+            f"initialize_runtime('127.0.0.1:{port}', 1, 0)\n"
+            f"initialize_runtime('127.0.0.1:{port}', 1, 0)\n"
+            "print(json.dumps(process_info()))\n"
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, env=env, timeout=120,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        info = json.loads(out.stdout.strip().splitlines()[-1])
+        assert info["process_index"] == 0
+        assert info["process_count"] == 1
