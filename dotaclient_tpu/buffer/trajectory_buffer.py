@@ -23,7 +23,7 @@ from typing import Any, Deque, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from dotaclient_tpu.config import RunConfig
 from dotaclient_tpu.train.ppo import example_batch

@@ -77,6 +77,7 @@ class PPOConfig:
     batch_rollouts: int = 32     # rollouts per optimizer step (B)
     epochs_per_batch: int = 1
     max_staleness: int = 4       # drop rollouts older than this many versions
+    moe_aux_coef: float = 0.01   # Switch load-balancing loss weight (MoE core)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -86,10 +86,11 @@ class MoEMLP(nn.Module):
         out = jnp.einsum("ecf,efd->ecd", h, w2.astype(dtype)) + b2[:, None].astype(dtype)
         y = jnp.einsum("bec,ecd->bd", combine.astype(dtype), out)
 
-        # aux load-balancing loss (Switch eq. 4): mean gate prob × mean
-        # token fraction per expert, scaled by E — stored for the learner
-        # to pick up via mutable "losses" collection when it cares
-        frac = dispatch.sum(axis=2).mean(axis=0)   # kept-token fraction / expert
-        imp = probs.mean(axis=0)
-        self.sow("losses", "moe_aux", E * jnp.sum(frac * imp))
+        # Per-token routing statistics for the Switch load-balancing loss
+        # (eq. 4: E · Σ_e mean-frac_e · mean-prob_e). Sown raw per token —
+        # NOT pre-averaged — so the learner can mask padded/bootstrap steps
+        # out of the means exactly like every other loss term (the cell
+        # cannot see the batch's valid mask from in here).
+        self.sow("losses", "moe_probs", probs)                 # [B, E]
+        self.sow("losses", "moe_frac", dispatch.sum(axis=2))   # [B, E] 0/1
         return y.astype(x.dtype)

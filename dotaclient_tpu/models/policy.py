@@ -213,6 +213,10 @@ class Policy(nn.Module):
         scan = nn.scan(
             scan_step,
             variable_broadcast="params",
+            # intermediates sown by the core (the MoE load-balancing loss,
+            # a scalar per step) stack along a leading time axis; empty for
+            # cores that sow nothing
+            variable_axes={"losses": 0},
             split_rngs={"params": False},
             in_axes=1,
             out_axes=1,
@@ -234,10 +238,16 @@ def make_policy(model: ModelConfig, obs_spec: ObsSpec, action_spec: ActionSpec) 
 
 def init_params(policy: Policy, rng: jax.Array):
     """Initialize parameters from a dummy batch-1 observation (shapes come
-    from the policy's own specs)."""
+    from the policy's own specs).
+
+    The ``losses`` collection (sown per-call intermediates like the MoE
+    load-balancing loss) is transient output, not state — it is stripped so
+    it never rides inside the param tree (where the learner's scan would
+    mistake it for a scannable variable)."""
     dummy = dummy_obs_batch(1, policy.obs_spec, policy.action_spec)
     carry = policy.initial_state(1)
-    return policy.init(rng, dummy, carry)
+    variables = policy.init(rng, dummy, carry)
+    return {k: v for k, v in variables.items() if k != "losses"}
 
 
 def dummy_obs_batch(

@@ -39,16 +39,13 @@ def initialize_runtime(
     environment. Explicit args serve CPU fleets and tests. Idempotent —
     calling twice (e.g. test re-entry) is a no-op rather than an error.
     """
-    try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
-    except RuntimeError as e:  # already initialized — keep first init
-        msg = str(e).lower()
-        if "already" not in msg and "only be called once" not in msg:
-            raise
+    if jax.distributed.is_initialized():
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
 
 
 def process_info() -> dict:

@@ -74,6 +74,33 @@ def init_train_state(policy_params: Any, cfg: PPOConfig) -> TrainState:
     )
 
 
+def _moe_aux_loss(losses_col: Any, valid: jnp.ndarray) -> jnp.ndarray:
+    """Switch load-balancing loss from the routing stats an MoE core sows.
+
+    Leaves arrive as ``[T+1, B, E]`` (the learner scan stacks one ``[B, E]``
+    sow per step on axis 0); padded steps and the trailing bootstrap slot
+    are masked out of the means exactly like every other loss term. Zero
+    for dense cores (empty collection).
+    """
+    if not losses_col:
+        return jnp.zeros(())
+    B, T = valid.shape
+    keystr = jax.tree_util.keystr
+    flat, _ = jax.tree_util.tree_flatten_with_path(losses_col)
+    probs = {keystr(p[:-2]): l for p, l in flat if "moe_probs" in keystr(p)}
+    fracs = {keystr(p[:-2]): l for p, l in flat if "moe_frac" in keystr(p)}
+    w = valid.T[..., None]                       # [T, B, 1]
+    denom = jnp.maximum(valid.sum(), 1.0)
+    aux = jnp.zeros(())
+    for key, pr in probs.items():
+        fr = fracs[key]
+        E = pr.shape[-1]
+        mean_p = (pr[:T] * w).sum((0, 1)) / denom   # [E] masked importance
+        mean_f = (fr[:T] * w).sum((0, 1)) / denom   # [E] masked load
+        aux = aux + E * jnp.sum(mean_p * mean_f)
+    return aux
+
+
 def ppo_loss(
     policy: Policy,
     params: Any,
@@ -86,9 +113,11 @@ def ppo_loss(
     valid = batch["valid"].astype(jnp.float32)
     n_valid = jnp.maximum(valid.sum(), 1.0)
 
-    logits, values, _ = policy.apply(
-        params, obs, batch["carry0"], batch["dones"], method="sequence"
+    (logits, values, _), mutated = policy.apply(
+        params, obs, batch["carry0"], batch["dones"], method="sequence",
+        mutable=["losses"],
     )
+    moe_aux = _moe_aux_loss(mutated.get("losses", {}), valid)
     # Trailing slot is the bootstrap step: value used, policy outputs unused.
     logits_t = {k: v[:, :T] for k, v in logits.items()}
     obs_t = {k: v[:, :T] for k, v in obs.items()}
@@ -114,9 +143,15 @@ def ppo_loss(
     value_loss = 0.5 * (jnp.square(values_t - returns) * valid).sum() / n_valid
     ent = (D.entropy(logits_t, obs_t) * valid).sum() / n_valid
 
-    loss = policy_loss + cfg.value_coef * value_loss - cfg.entropy_coef * ent
+    loss = (
+        policy_loss
+        + cfg.value_coef * value_loss
+        - cfg.entropy_coef * ent
+        + cfg.moe_aux_coef * moe_aux
+    )
     metrics = {
         "loss": loss,
+        "moe_aux": moe_aux,
         "policy_loss": policy_loss,
         "value_loss": value_loss,
         "entropy": ent,

@@ -152,3 +152,23 @@ class TestMoEMLP:
         assert np.isfinite(float(np.asarray(metrics["loss"])))
         w1 = state.params["params"]["core"]["block_0"]["moe"]["expert_w1"]
         assert w1.sharding.spec == P("model", None, None)
+        # the Switch load-balancing aux loss flows into the objective:
+        # ≥ 1 by Cauchy-Schwarz for top-1 routing (== 1 iff perfectly
+        # balanced), and 0 only for dense cores
+        aux = float(np.asarray(metrics["moe_aux"]))
+        assert aux >= 0.99, aux
+
+    def test_dense_core_has_zero_aux(self):
+        from dotaclient_tpu.models import init_params, make_policy
+        from dotaclient_tpu.train.ppo import ppo_loss, example_batch
+
+        cfg = default_config()
+        cfg = dataclasses.replace(
+            cfg, ppo=dataclasses.replace(cfg.ppo, rollout_len=4)
+        )
+        policy = make_policy(cfg.model, cfg.obs, cfg.actions)
+        params = init_params(policy, jax.random.PRNGKey(0))
+        _, metrics = ppo_loss(
+            policy, params, example_batch(cfg, batch=2), cfg.ppo
+        )
+        assert float(np.asarray(metrics["moe_aux"])) == 0.0
