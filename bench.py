@@ -107,6 +107,19 @@ def main() -> None:
             e2e_fps, e2e_steps * B * T / (time.perf_counter() - t0)
         )
 
+    # -- fused mode: rollout + update as ONE program per optimizer step ------
+    fused_learner = Learner(e2e_config, actor="fused")
+    fused_learner.train(10)    # compile + settle
+    fused_frames = fused_learner.device_actor.n_lanes * T
+    fused_fps = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        fused_learner.train(e2e_steps)
+        fused_fps = max(
+            fused_fps, e2e_steps * fused_frames / (time.perf_counter() - t0)
+        )
+    del fused_learner
+
     # -- actor rollout generation alone --------------------------------------
     da = learner.device_actor
     actor_params = learner.state.params
@@ -146,6 +159,7 @@ def main() -> None:
                 "unit": "frames/sec",
                 "vs_baseline": round(frames_per_sec / anchor, 3),
                 "end_to_end_frames_per_sec": round(e2e_fps, 1),
+                "fused_frames_per_sec": round(fused_fps, 1),
                 "actor_frames_per_sec": round(actor_fps, 1),
             }
         )

@@ -1,0 +1,81 @@
+"""Fully-fused synchronous iteration: rollout + PPO update, ONE XLA program.
+
+The reference's loop crosses process and device boundaries every iteration
+(actor → RMQ → learner → GPU, SURVEY.md §3.1–3.2). The device actor already
+collapsed the actor side into a single program; this module goes the rest of
+the way for the synchronous on-policy regime: the whole iteration —
+T-step rollout scan (featurize, policy, sample, env step, reward, episode
+reset), then the PPO update on the chunk it just produced — is one jitted,
+donated call. One dispatch per optimizer step, zero host round-trips,
+nothing staged through the trajectory buffer.
+
+This is the Anakin architecture (PAPERS.md [P:7]) taken to its endpoint, and
+it matters here concretely: the sandbox's tunneled TPU charges ~100 ms per
+host↔device sync, so the buffered device loop (collect + scatter + gather +
+train ≈ 4–5 dispatches) is dispatch-dominated at small batch.
+
+Trade-offs vs the buffered path (why both exist):
+  * strictly on-policy — every chunk is trained on exactly once, by the
+    params that generated it (behavior_logp ratio ≡ 1 at epoch 1); the
+    staleness/version machinery has nothing to do;
+  * the train batch IS the lane set (``n_lanes`` rollouts of length T) —
+    ``ppo.batch_rollouts`` does not apply;
+  * ``epochs_per_batch`` > 1 is unsupported (the chunk lives only inside
+    the program);
+  * no cross-process experience — single-host self-play only.
+
+The learner exposes it as ``actor="fused"``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from dotaclient_tpu.config import RunConfig
+from dotaclient_tpu.models.policy import Policy
+from dotaclient_tpu.parallel.mesh import data_sharding, replicated
+from dotaclient_tpu.train.ppo import _train_step, train_state_sharding
+
+
+def make_fused_step(policy: Policy, config: RunConfig, mesh, actor):
+    """Compile (state, actor_state, opp_params) → (state', actor_state',
+    metrics, stats) against ``mesh``.
+
+    The train state keeps the TP/DP shardings of ``make_train_step``; the
+    chunk produced mid-program is constrained to the batch sharding so the
+    PPO update runs exactly as it would on a buffered batch; the actor's
+    sim/carry state is replicated (its arrays are small and the rollout
+    math is elementwise over lanes). ``opp_params`` must always be passed —
+    self-play callers pass the live params (the jitted program has one
+    signature for both modes).
+    """
+    ds = data_sharding(mesh, config.mesh)
+    repl = replicated(mesh)
+    st_sh = train_state_sharding(policy, config, mesh)
+
+    def fused(state, actor_state, opp_params):
+        actor_state, chunk, stats = actor._rollout_impl(
+            state.params, actor_state, opp_params
+        )
+        chunk = jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(x, ds), chunk
+        )
+        new_state, metrics = _train_step(policy, config.ppo, state, chunk)
+        return new_state, actor_state, metrics, stats
+
+    # No donation: in self-play the caller passes state.params AS
+    # opp_params (one signature for both modes), so donating the state
+    # would alias a donated buffer with a live input; the actor state's
+    # zero carries can likewise alias a cached constant on the first call.
+    # The state is LSTM(128)-scale — the copy cost is noise next to the
+    # dispatch savings this path exists for.
+    # opp_params shards like the live params (st_sh's params subtree): under
+    # TP, pinning it replicated would all-gather the full param set every
+    # step — on the one-dispatch hot path this module exists to shorten.
+    return jax.jit(
+        fused,
+        in_shardings=(st_sh, repl, st_sh.params),
+        out_shardings=(st_sh, repl, repl, repl),
+    )

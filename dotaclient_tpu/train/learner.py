@@ -71,15 +71,27 @@ class Learner:
         actor: Optional[str] = None,
         debug_checkify: bool = False,
     ) -> None:
-        # actor mode: "device" (on-device rollout scan — fastest, default for
-        # training runs), "vec" (numpy vectorized sim, host-driven), "scalar"
-        # (proto/gRPC-parity pool), "external" (no in-process actors — N
-        # standalone `python -m dotaclient_tpu.actor` processes feed the
-        # transport, the reference's scale-out topology, SURVEY.md §1).
-        # `vec` kept for backward compatibility.
+        # actor mode: "device" (on-device rollout scan feeding the buffered
+        # learner), "fused" (rollout + PPO update in ONE XLA program — the
+        # fastest synchronous path; train batch = lane set, strictly
+        # on-policy, see train/fused.py), "vec" (numpy vectorized sim,
+        # host-driven), "scalar" (proto/gRPC-parity pool), "external" (no
+        # in-process actors — N standalone `python -m dotaclient_tpu.actor`
+        # processes feed the transport, the reference's scale-out topology,
+        # SURVEY.md §1). `vec` kept for backward compatibility.
         mode = actor or ("vec" if vec else "scalar")
-        if mode not in ("device", "vec", "scalar", "external"):
+        if mode not in ("device", "fused", "vec", "scalar", "external"):
             raise ValueError(f"unknown actor mode {mode!r}")
+        if mode == "fused" and config.ppo.epochs_per_batch != 1:
+            raise ValueError(
+                "fused mode trains each chunk exactly once inside the "
+                "program; epochs_per_batch must be 1"
+            )
+        if mode == "fused" and debug_checkify:
+            raise ValueError(
+                "checkify instruments the buffered train step, which fused "
+                "mode never calls — use actor='device' to hunt NaNs"
+            )
         if mode == "external" and transport is None:
             raise ValueError(
                 "external actor mode needs a transport (TransportServer or "
@@ -100,7 +112,12 @@ class Learner:
         self.train_step = make_train_step(
             self.policy, config, self.mesh, debug_checkify=debug_checkify
         )
-        self.buffer = TrajectoryBuffer(config, self.mesh)
+        # Fused mode trains each chunk inside its one program and never
+        # stages experience: allocating the HBM ring there would pin
+        # capacity_rollouts chunks of dead device memory.
+        self.buffer = (
+            None if mode == "fused" else TrajectoryBuffer(config, self.mesh)
+        )
         self.transport = transport or InProcTransport()
         # Vectorized mode ships decoded rollouts through an in-proc deque
         # (thread-safe append/drain) — no proto round-trip on the hot path;
@@ -112,13 +129,20 @@ class Learner:
             if mode == "vec" else None
         )
         self.device_actor = None
+        self.fused_step = None
         if mode == "external":
             self.pool = None
-        elif mode == "device":
+        elif mode in ("device", "fused"):
             from dotaclient_tpu.actor.device_rollout import DeviceActor
 
             self.device_actor = DeviceActor(config, self.policy, seed=seed)
             self.pool: Any = self.device_actor  # shared stats() surface
+            if mode == "fused":
+                from dotaclient_tpu.train.fused import make_fused_step
+
+                self.fused_step = make_fused_step(
+                    self.policy, config, self.mesh, self.device_actor
+                )
         elif mode == "vec":
             self.pool = VecActorPool(
                 config,
@@ -225,7 +249,9 @@ class Learner:
         full device state — sim worlds, recurrent carries, PRNG, episode
         accumulators — as flat leaves (checkpoint-format-stable regardless
         of the NamedTuple nesting)."""
-        out: Dict[str, Any] = {"buffer": self.buffer.state_dict()}
+        out: Dict[str, Any] = (
+            {"buffer": self.buffer.state_dict()} if self.buffer else {}
+        )
         if self.device_actor is not None:
             leaves = jax.tree.leaves(jax.device_get(self.device_actor.state))
             out["actor_leaves"] = {f"{i:04d}": leaf for i, leaf in enumerate(leaves)}
@@ -242,7 +268,8 @@ class Learner:
                     flush=True,
                 )
             return
-        self.buffer.load_state_dict(restored["buffer"])
+        if self.buffer is not None and "buffer" in restored:
+            self.buffer.load_state_dict(restored["buffer"])
         if self.device_actor is not None and "actor_leaves" in restored:
             treedef = jax.tree.structure(self.device_actor.state)
             leaves = [
@@ -260,6 +287,20 @@ class Learner:
                 self._host_version,
             )
         )
+
+    def _league_opponent(self):
+        """Snapshot-if-due and draw the frozen opponent's params for the
+        device/fused loops. None when no league is configured (self-play /
+        scripted opponents)."""
+        if self.league is None:
+            return None
+        self.league.maybe_snapshot(
+            self.state.params, self._host_version, self._host_step
+        )
+        params, _ = self.league.sample(
+            self.state.params, self._host_version
+        )
+        return params
 
     def _refresh_league_opponent(self) -> None:
         """Snapshot-if-due and re-draw the frozen opponent (host-pool modes;
@@ -295,9 +336,13 @@ class Learner:
         frames_trained = 0
         steps_done = 0
 
-        def after_step(m) -> None:
+        def after_step(m, frames: Optional[int] = None) -> None:
             nonlocal frames_trained
-            frames_trained += cfg.ppo.batch_rollouts * cfg.ppo.rollout_len
+            frames_trained += (
+                frames
+                if frames is not None
+                else cfg.ppo.batch_rollouts * cfg.ppo.rollout_len
+            )
             step = self._host_step
             if step % cfg.log_every < epochs:
                 # ONE transfer for the whole metrics dict.
@@ -308,7 +353,8 @@ class Learner:
                     scalars.update(self.device_actor.drain_stats())
                 elif self.pool is not None:
                     scalars.update(self.pool.stats())
-                scalars.update(self.buffer.metrics())
+                if self.buffer is not None:
+                    scalars.update(self.buffer.metrics())
                 elapsed = time.time() - t_start
                 scalars["frames_per_sec"] = frames_trained / max(elapsed, 1e-9)
                 self._last_metrics = scalars
@@ -322,20 +368,31 @@ class Learner:
                 # end-of-run save below captures the complete pipeline
                 self.ckpt.save(self.state, cfg)
 
-        if self.device_actor is not None:
+        if self.fused_step is not None:
+            # Fused mode: rollout + update is ONE program, one dispatch per
+            # optimizer step (train/fused.py). Train batch = the lane set.
+            da = self.device_actor
+            frames_per = da.n_lanes * cfg.ppo.rollout_len
+            while steps_done < num_steps:
+                opp_params = self._league_opponent()
+                if opp_params is None:       # self-play / scripted: one
+                    opp_params = self.state.params   # signature for all modes
+                self.state, da.state, m, _ = self.fused_step(
+                    self.state, da.state, opp_params
+                )
+                self._host_step += 1
+                self._host_version += 1
+                da.env_steps += frames_per
+                da.rollouts_shipped += da.n_lanes
+                steps_done += 1
+                after_step(m, frames=frames_per)
+        elif self.device_actor is not None:
             # On-device rollout mode: collect→ingest→train is all dispatch
             # (the device serializes rollout and train programs back-to-back,
             # so a host thread would add nothing; `overlap` is a no-op here).
             da = self.device_actor
             while steps_done < num_steps:
-                opp_params = None
-                if self.league is not None:
-                    self.league.maybe_snapshot(
-                        self.state.params, self._host_version, self._host_step
-                    )
-                    opp_params, _ = self.league.sample(
-                        self.state.params, self._host_version
-                    )
+                opp_params = self._league_opponent()
                 chunk, _ = da.collect(self.state.params, opp_params=opp_params)
                 self.buffer.add_device(chunk, self._host_version)
                 while (
@@ -466,8 +523,9 @@ def main(argv=None) -> Dict[str, float]:
     )
     p.add_argument(
         "--actor", type=str, default=None,
-        choices=("device", "vec", "scalar", "external"),
+        choices=("device", "fused", "vec", "scalar", "external"),
         help="actor implementation: on-device rollout scan (default), "
+        "fused single-program rollout+update (fastest synchronous path), "
         "numpy vectorized sim, scalar proto pool, or external "
         "(standalone `python -m dotaclient_tpu.actor` processes)",
     )

@@ -186,6 +186,21 @@ def _train_step(
     return new_state, metrics
 
 
+def train_state_sharding(policy: Policy, config: RunConfig, mesh: Mesh):
+    """The TrainState sharding tree (TP partition rules applied to params
+    and the Adam mirrors, scalars replicated) — the single source of truth
+    shared by ``make_train_step`` and the fused step."""
+    from dotaclient_tpu.models import init_params
+    from dotaclient_tpu.parallel.sharding import state_shardings
+
+    state_shape = jax.eval_shape(
+        lambda: init_train_state(
+            init_params(policy, jax.random.PRNGKey(0)), config.ppo
+        )
+    )
+    return state_shardings(state_shape, mesh, config.mesh)
+
+
 def make_train_step(
     policy: Policy,
     config: RunConfig,
@@ -202,9 +217,6 @@ def make_train_step(
     collectives (model axis) over ICI. The train state is donated —
     params/opt-state update in place in HBM.
     """
-    from dotaclient_tpu.models import init_params
-    from dotaclient_tpu.parallel.sharding import state_shardings
-
     from dotaclient_tpu.parallel.mesh import data_sharding as _data_sharding
 
     # (dcn, data) when the mesh is multi-slice, else just (data,): the
@@ -215,12 +227,7 @@ def make_train_step(
     batch_shardings = jax.tree.map(
         lambda _: data_sharding, example_batch(config, batch=1, as_struct=True)
     )
-    state_shape = jax.eval_shape(
-        lambda: init_train_state(
-            init_params(policy, jax.random.PRNGKey(0)), config.ppo
-        )
-    )
-    state_sharding = state_shardings(state_shape, mesh, config.mesh)
+    state_sharding = train_state_sharding(policy, config, mesh)
     metrics_repl = repl
     if debug_checkify:
         # Debug numerics mode (SURVEY.md §5.2): checkify float checks guard
