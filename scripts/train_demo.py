@@ -35,6 +35,10 @@ def main() -> None:
     p.add_argument("--n-envs", type=int, default=128)
     p.add_argument("--eval-games", type=int, default=64)
     p.add_argument("--logdir", type=str, default=None)
+    p.add_argument("--actor", type=str, default="fused",
+                   choices=("fused", "device"),
+                   help="fused: one program per optimizer step (fastest); "
+                   "device: buffered loop (round-2 demo parity)")
     args = p.parse_args()
 
     from dotaclient_tpu.config import default_config
@@ -51,10 +55,13 @@ def main() -> None:
         buffer=dataclasses.replace(
             config.buffer, capacity_rollouts=512, min_fill=128
         ),
-        log_every=10_000,
+        # drain-free logging: a mid-block log boundary would reset the
+        # windowed stats the demo prints (TensorBoard cadence only
+        # matters when a logdir is given)
+        log_every=10_000 if args.logdir else 1_000_000_000,
         seed=args.seed,
     )
-    learner = Learner(config, actor="device", seed=args.seed, logdir=args.logdir)
+    learner = Learner(config, actor=args.actor, seed=args.seed, logdir=args.logdir)
     policy = learner.policy
     init_params = jax.tree.map(lambda x: x.copy(), learner.state.params)
 
@@ -102,7 +109,11 @@ def main() -> None:
                        n_games=args.eval_games, seed=7)
     summary = {
         "steps": args.steps,
-        "frames": args.steps * config.ppo.batch_rollouts * config.ppo.rollout_len,
+        "frames": args.steps * config.ppo.rollout_len * (
+            learner.device_actor.n_lanes
+            if args.actor == "fused"
+            else config.ppo.batch_rollouts
+        ),
         "wall_sec": round(time.time() - t0, 1),
         "init_win_vs_easy": round(init_easy["win_rate"], 3),
         "init_win_vs_hard": round(init_hard["win_rate"], 3),
