@@ -60,6 +60,14 @@ class TrajectoryBuffer:
                 f"sharded over these axes)"
             )
         self.capacity = cap
+        # Staleness is denominated in CONSUMED BATCHES (the cadence actors
+        # can actually refresh at), while the version counter ticks once per
+        # optimizer step — epochs_per_batch × minibatches ticks per batch.
+        # Scale the threshold so max_staleness keeps meaning "batches
+        # behind" regardless of the multi-epoch/minibatch configuration.
+        self._staleness_limit = config.ppo.max_staleness * (
+            config.ppo.epochs_per_batch * max(1, config.ppo.minibatches)
+        )
         self._sharding = data_sharding(mesh, config.mesh)
         template = example_batch(config, batch=cap)
         self._store = jax.tree.map(
@@ -121,7 +129,7 @@ class TrajectoryBuffer:
         """
         fresh = []
         for meta, arrays in rollouts:
-            if current_version - meta["model_version"] > self.config.ppo.max_staleness:
+            if current_version - meta["model_version"] > self._staleness_limit:
                 self.dropped_stale += 1
                 continue
             fresh.append((meta, arrays))
@@ -218,7 +226,7 @@ class TrajectoryBuffer:
         """
         b = batch_size or self.config.ppo.batch_rollouts
         if current_version is not None:
-            max_st = self.config.ppo.max_staleness
+            max_st = self._staleness_limit
             stale = [
                 s for s in self._order
                 if current_version - self._slot_version[s] > max_st

@@ -76,6 +76,7 @@ class PPOConfig:
     rollout_len: int = 16        # truncated-BPTT chunk length T
     batch_rollouts: int = 32     # rollouts per optimizer step (B)
     epochs_per_batch: int = 1
+    minibatches: int = 1         # shuffled minibatch splits per epoch
     max_staleness: int = 4       # drop rollouts older than this many versions
     moe_aux_coef: float = 0.01   # Switch load-balancing loss weight (MoE core)
 

@@ -82,10 +82,20 @@ class Learner:
         mode = actor or ("vec" if vec else "scalar")
         if mode not in ("device", "fused", "vec", "scalar", "external"):
             raise ValueError(f"unknown actor mode {mode!r}")
-        if mode == "fused" and config.ppo.epochs_per_batch != 1:
+        if mode == "fused" and (
+            config.ppo.epochs_per_batch != 1 or config.ppo.minibatches != 1
+        ):
             raise ValueError(
                 "fused mode trains each chunk exactly once inside the "
-                "program; epochs_per_batch must be 1"
+                "program; epochs_per_batch and minibatches must be 1"
+            )
+        if (
+            config.ppo.minibatches > 1
+            and config.ppo.batch_rollouts % config.ppo.minibatches
+        ):
+            raise ValueError(
+                f"batch_rollouts {config.ppo.batch_rollouts} not "
+                f"divisible by minibatches {config.ppo.minibatches}"
             )
         if mode == "fused" and debug_checkify:
             raise ValueError(
@@ -100,6 +110,19 @@ class Learner:
         self.actor_mode = mode
         self.config = config
         self.mesh = make_mesh(config.mesh)
+        if config.ppo.minibatches > 1:
+            # each minibatch is itself a data-sharded train batch
+            from dotaclient_tpu.parallel.mesh import batch_axes
+
+            shards = 1
+            for a in batch_axes(self.mesh, config.mesh):
+                shards *= self.mesh.shape[a]
+            mb = config.ppo.batch_rollouts // config.ppo.minibatches
+            if mb % shards:
+                raise ValueError(
+                    f"minibatch size {mb} not divisible by the batch shard "
+                    f"count {shards} (minibatches are data-sharded batches)"
+                )
         self.policy = make_policy(config.model, config.obs, config.actions)
         params = init_params(self.policy, jax.random.PRNGKey(config.seed))
         self.state = init_train_state(params, config.ppo)
@@ -185,6 +208,22 @@ class Learner:
                 )
         self.metrics = MetricsLogger(logdir)
         self.frames_per_rollout = config.ppo.rollout_len
+        # Minibatch machinery: one jitted gather (a tree of row-gathers is
+        # otherwise a dispatch per leaf), host RNG for the shuffles, and the
+        # optimizer-steps-per-consumed-batch stride used by counters and
+        # log/checkpoint gating.
+        from dotaclient_tpu.parallel import data_sharding
+
+        self._minibatch_gather = jax.jit(
+            lambda batch, idx: jax.tree.map(lambda x: x[idx], batch),
+            # minibatches must arrive at the train step in its batch
+            # sharding (the donated step pins its in_shardings)
+            out_shardings=data_sharding(self.mesh, config.mesh),
+        )
+        self._mb_rng = np.random.default_rng(config.seed + 1)
+        self._steps_per_batch = config.ppo.epochs_per_batch * max(
+            1, config.ppo.minibatches
+        )
         self._last_metrics: Dict[str, float] = {}
         # Host-side mirrors of state.step/state.version: reading the device
         # scalars costs a full sync per read, so the loop never does.
@@ -228,13 +267,27 @@ class Learner:
         )
 
     def _optimize(self, batch) -> Dict[str, jnp.ndarray]:
-        """Run ``epochs_per_batch`` optimizer passes over one batch
-        (dispatch-only; the reference's multi-epoch PPO pass). Returns the
-        last pass's (device-resident) metrics."""
-        for _ in range(self.config.ppo.epochs_per_batch):
-            self.state, m = self.train_step(self.state, batch)
-            self._host_step += 1
-            self._host_version += 1
+        """Run ``epochs_per_batch`` passes over one batch, each split into
+        ``minibatches`` shuffled slices (the standard PPO regime; with the
+        defaults of 1×1 this is a single donated step). Dispatch-only.
+        Returns the last pass's (device-resident) metrics."""
+        cfg = self.config.ppo
+        M = max(1, cfg.minibatches)
+        for _ in range(cfg.epochs_per_batch):
+            if M == 1:
+                self.state, m = self.train_step(self.state, batch)
+                self._host_step += 1
+                self._host_version += 1
+                continue
+            B = cfg.batch_rollouts
+            mb = B // M
+            perm = self._mb_rng.permutation(B)
+            for i in range(M):
+                idx = jnp.asarray(perm[i * mb:(i + 1) * mb], jnp.int32)
+                sub = self._minibatch_gather(batch, idx)
+                self.state, m = self.train_step(self.state, sub)
+                self._host_step += 1
+                self._host_version += 1
         return m
 
     def _actor_params_copy(self):
@@ -330,7 +383,7 @@ class Learner:
         the staleness filter and version tags do real work here.
         """
         cfg = self.config
-        epochs = cfg.ppo.epochs_per_batch
+        epochs = self._steps_per_batch
         actor_steps = actor_steps_per_iter or cfg.ppo.rollout_len
         t_start = time.time()
         frames_trained = 0

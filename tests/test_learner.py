@@ -39,6 +39,36 @@ class TestLearnerLoop:
         assert learner.pool.version >= 2
 
 
+class TestMinibatchEpochs:
+    def test_minibatched_multi_epoch_training(self):
+        """epochs_per_batch × minibatches shuffled slices per consumed
+        batch — the standard PPO regime; counters advance per optimizer
+        step (one per minibatch)."""
+        cfg = tiny_config()
+        cfg = dataclasses.replace(
+            cfg,
+            ppo=dataclasses.replace(
+                cfg.ppo, epochs_per_batch=2, minibatches=2, batch_rollouts=16
+            ),
+            log_every=4,   # a boundary fires within the run → loss captured
+        )
+        learner = Learner(cfg)
+        stats = learner.train(4)   # one consumed batch = 4 optimizer steps
+        assert stats["optimizer_steps"] == 4
+        assert int(learner.state.step) == 4
+        assert "loss" in stats and np.isfinite(stats["loss"])
+        # frames count unique experience: one batch consumed
+        assert stats["frames_trained"] == 16 * 8
+
+    def test_indivisible_minibatches_rejected(self):
+        cfg = tiny_config()
+        cfg = dataclasses.replace(
+            cfg, ppo=dataclasses.replace(cfg.ppo, minibatches=3)
+        )
+        with pytest.raises(ValueError, match="divisible"):
+            Learner(cfg)
+
+
 class TestCheckpoint:
     def test_save_restore_roundtrip(self, tmp_path):
         cfg = tiny_config()
