@@ -65,8 +65,8 @@ class TrajectoryBuffer:
         # optimizer step — epochs_per_batch × minibatches ticks per batch.
         # Scale the threshold so max_staleness keeps meaning "batches
         # behind" regardless of the multi-epoch/minibatch configuration.
-        self._staleness_limit = config.ppo.max_staleness * (
-            config.ppo.epochs_per_batch * max(1, config.ppo.minibatches)
+        self._staleness_limit = (
+            config.ppo.max_staleness * config.ppo.steps_per_batch
         )
         self._sharding = data_sharding(mesh, config.mesh)
         template = example_batch(config, batch=cap)

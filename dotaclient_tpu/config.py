@@ -77,8 +77,15 @@ class PPOConfig:
     batch_rollouts: int = 32     # rollouts per optimizer step (B)
     epochs_per_batch: int = 1
     minibatches: int = 1         # shuffled minibatch splits per epoch
-    max_staleness: int = 4       # drop rollouts older than this many versions
+    max_staleness: int = 4       # drop rollouts older than this many BATCHES
     moe_aux_coef: float = 0.01   # Switch load-balancing loss weight (MoE core)
+
+    @property
+    def steps_per_batch(self) -> int:
+        """Optimizer steps (= version ticks) per consumed batch — the unit
+        ``max_staleness`` is denominated in. Shared by the learner's
+        counters and the buffer's staleness window so they cannot drift."""
+        return self.epochs_per_batch * max(1, self.minibatches)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -221,9 +221,8 @@ class Learner:
             out_shardings=data_sharding(self.mesh, config.mesh),
         )
         self._mb_rng = np.random.default_rng(config.seed + 1)
-        self._steps_per_batch = config.ppo.epochs_per_batch * max(
-            1, config.ppo.minibatches
-        )
+        self._mb_draws = 0          # permutations consumed (for exact resume)
+        self._steps_per_batch = config.ppo.steps_per_batch
         self._last_metrics: Dict[str, float] = {}
         # Host-side mirrors of state.step/state.version: reading the device
         # scalars costs a full sync per read, so the loop never does.
@@ -282,6 +281,7 @@ class Learner:
             B = cfg.batch_rollouts
             mb = B // M
             perm = self._mb_rng.permutation(B)
+            self._mb_draws += 1
             for i in range(M):
                 idx = jnp.asarray(perm[i * mb:(i + 1) * mb], jnp.int32)
                 sub = self._minibatch_gather(batch, idx)
@@ -308,6 +308,9 @@ class Learner:
         if self.device_actor is not None:
             leaves = jax.tree.leaves(jax.device_get(self.device_actor.state))
             out["actor_leaves"] = {f"{i:04d}": leaf for i, leaf in enumerate(leaves)}
+        # minibatch-shuffle RNG position: the stream is seeded, so the count
+        # of consumed permutations reconstructs it exactly on restore
+        out["mb_draws"] = np.asarray(self._mb_draws, np.int64)
         return out
 
     def _restore_pipeline(self) -> None:
@@ -330,6 +333,12 @@ class Learner:
                 for k in sorted(restored["actor_leaves"])
             ]
             self.device_actor.state = jax.tree.unflatten(treedef, leaves)
+        if "mb_draws" in restored:
+            # fast-forward the seeded shuffle stream to its saved position
+            self._mb_draws = int(np.asarray(restored["mb_draws"]))
+            self._mb_rng = np.random.default_rng(self.config.seed + 1)
+            for _ in range(self._mb_draws):
+                self._mb_rng.permutation(self.config.ppo.batch_rollouts)
 
     def _publish_weights(self) -> None:
         """Serialize current params to the transport's weights fanout (one

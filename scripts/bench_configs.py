@@ -87,6 +87,10 @@ def main() -> None:
     p.add_argument("--steps", type=int, default=100,
                    help="timed optimizer steps per config")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--mode", type=str, default="device",
+                   choices=("device", "fused"),
+                   help="device: buffered loop; fused: one program per step "
+                   "(batch = lane set, so frames/step scales with lanes)")
     args = p.parse_args()
 
     from dotaclient_tpu.config import default_config
@@ -97,16 +101,22 @@ def main() -> None:
     results = []
     for n in (int(s) for s in args.configs.split(",")):
         cfg, desc = build_config(n, base)
-        learner = Learner(cfg, actor="device", seed=args.seed)
+        learner = Learner(cfg, actor=args.mode, seed=args.seed)
+        frames_per_step = (
+            learner.device_actor.n_lanes * T if args.mode == "fused" else B * T
+        )
         learner.train(20)          # compile + buffer warmup
         fps = 0.0
         for _ in range(3):         # best-of-3: tunneled-TPU service jitter
             t0 = time.perf_counter()
             learner.train(args.steps)
-            fps = max(fps, args.steps * B * T / (time.perf_counter() - t0))
+            fps = max(
+                fps, args.steps * frames_per_step / (time.perf_counter() - t0)
+            )
         row = {
             "config": n,
             "desc": desc,
+            "mode": args.mode,
             "end_to_end_frames_per_sec": round(fps, 1),
             "n_envs": cfg.env.n_envs,
             "team_size": cfg.env.team_size,

@@ -61,6 +61,34 @@ class TestKillAndResume:
                 f"metric {k} diverged after resume: {a_metrics[k]} vs {b_metrics[k]}"
             )
 
+    def test_fused_mode_resume_reproduces_metrics(self, tmp_path):
+        """Fused mode has no buffer; its pipeline state is the train state
+        plus the device actor's full state — resume must still reproduce
+        identical subsequent metrics."""
+        cfg = small_config()
+        ckdir = str(tmp_path / "ck")
+        from dotaclient_tpu.utils.checkpoint import CheckpointManager
+
+        a = Learner(cfg, seed=5, actor="fused")
+        a.train(3)
+        mgr = CheckpointManager(ckdir)
+        mgr.save(a.state, cfg, force=True, pipeline=a._pipeline_state())
+        mgr.wait()
+        a.train(3)
+        a_metrics = dict(a._last_metrics)
+
+        b = Learner(
+            cfg, checkpoint_dir=ckdir, restore=True, seed=999, actor="fused"
+        )
+        assert b._host_step == 3
+        b.train(3)
+        b_metrics = dict(b._last_metrics)
+        for k in ("loss", "policy_loss", "value_loss", "entropy", "reward_mean"):
+            assert a_metrics[k] == pytest.approx(b_metrics[k], rel=1e-5), (
+                f"metric {k} diverged after fused resume: "
+                f"{a_metrics[k]} vs {b_metrics[k]}"
+            )
+
     def test_restore_without_pipeline_still_works(self, tmp_path):
         """Weights-only checkpoints (no pipeline entry) restore cleanly."""
         cfg = small_config()

@@ -60,6 +60,36 @@ class TestMinibatchEpochs:
         # frames count unique experience: one batch consumed
         assert stats["frames_trained"] == 16 * 8
 
+    def test_minibatch_resume_reproduces_metrics(self, tmp_path):
+        """The shuffle-stream position is checkpointed: a resumed learner
+        replays the SAME upcoming permutations as the original's
+        continuation (rel-tol: resumed state crosses a save/restore
+        round-trip)."""
+        from dotaclient_tpu.utils.checkpoint import CheckpointManager
+
+        cfg = tiny_config()
+        cfg = dataclasses.replace(
+            cfg,
+            ppo=dataclasses.replace(
+                cfg.ppo, epochs_per_batch=1, minibatches=2, batch_rollouts=16
+            ),
+            log_every=1,
+        )
+        ckdir = str(tmp_path / "ck")
+        a = Learner(cfg, seed=4, actor="device")
+        a.train(2)
+        mgr = CheckpointManager(ckdir)
+        mgr.save(a.state, cfg, force=True, pipeline=a._pipeline_state())
+        mgr.wait()
+        a.train(2)
+        b = Learner(cfg, checkpoint_dir=ckdir, restore=True, actor="device")
+        assert b._mb_draws == a._mb_draws - 1  # one batch consumed post-save
+        b.train(2)
+        for k in ("loss", "policy_loss", "entropy"):
+            assert a._last_metrics[k] == pytest.approx(
+                b._last_metrics[k], rel=1e-5
+            ), f"{k} diverged after minibatch resume"
+
     def test_indivisible_minibatches_rejected(self):
         cfg = tiny_config()
         cfg = dataclasses.replace(
