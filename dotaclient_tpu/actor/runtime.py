@@ -307,7 +307,10 @@ class ActorPool:
             lane.actions.append(idx)
             lane.logps.append(float(logp_np[i]))
             lane.obs_seq.append(lane.obs)
-            proto = decode_action(idx, lane.obs, lane.player_id)
+            proto = decode_action(
+                idx, lane.obs, lane.player_id,
+                move_bins=self.config.actions.move_bins,
+            )
             by_env_team.setdefault((lane.env_idx, lane.team_id), []).append(proto)
         for (env_idx, team_id), protos in by_env_team.items():
             self.envs[env_idx].act(

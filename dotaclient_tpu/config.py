@@ -124,6 +124,15 @@ class LeagueConfig:
     pool_size: int = 8
     snapshot_every: int = 200      # learner steps between opponent snapshots
     selfplay_prob: float = 0.5     # chance of facing the latest policy
+    # Snapshot matchmaking: "uniform" | "pfsp" (prioritized fictitious
+    # self-play — weight (1-winrate)^pfsp_power, replay hard opponents).
+    matchmaking: str = "pfsp"
+    pfsp_power: float = 2.0
+    # Optimizer steps a drawn opponent is held before redrawing: episodes
+    # span many rollout chunks, so holding keeps most of an episode against
+    # ONE opponent — the per-chunk outcome attribution PFSP feeds on stays
+    # meaningful, and lanes stop seeing mid-episode opponent swaps.
+    opponent_hold: int = 64
 
 
 @dataclasses.dataclass(frozen=True)

@@ -120,6 +120,12 @@ def featurize(
     mx = me.location.x if me is not None else 0.0
     my_ = me.location.y if me is not None else 0.0
     me_alive = bool(me is not None and me.is_alive)
+    # Team-canonical frame: +x always points at the ENEMY tower (the map is
+    # symmetric about x=0, towers at ±LANE_HALF_LENGTH). Without this a
+    # policy is side-specific — trained as Radiant it cannot be executed on
+    # Dire lanes (league opponents, eval mirrors), which shows up as wild
+    # side asymmetries in self-play. decode_action mirrors move_x back.
+    sign = 1.0 if my_team == 2 else -1.0
 
     others = sorted(
         (u for u in world_state.units if me is None or u.handle != me.handle),
@@ -141,7 +147,7 @@ def featurize(
     for slot, unit in enumerate(ordered[:U]):
         is_self = me is not None and unit.handle == me.handle
         is_ally = unit.team_id == my_team
-        dx = (unit.location.x - mx) / _POS_SCALE
+        dx = (unit.location.x - mx) * sign / _POS_SCALE
         dy = (unit.location.y - my_) / _POS_SCALE
         dist = float(np.hypot(unit.location.x - mx, unit.location.y - my_))
         castable = any(a.castable for a in unit.abilities)
@@ -158,7 +164,7 @@ def featurize(
             float(is_ally),
             float(not is_ally),
             float(is_self),
-            unit.location.x / _POS_SCALE,
+            unit.location.x * sign / _POS_SCALE,
             unit.location.y / _POS_SCALE,
             dx,
             dy,
@@ -264,6 +270,7 @@ def decode_action(
     action_indices: Mapping[str, int],
     obs: Observation,
     player_id: int,
+    move_bins: int = 9,
 ) -> pb.Action:
     """Inverse codec: per-head indices sampled by the policy → Action proto.
 
@@ -273,7 +280,10 @@ def decode_action(
     a_type = int(action_indices["action_type"])
     action = pb.Action(player_id=player_id, type=a_type)
     if a_type == pb.ACTION_MOVE:
-        action.move_x = int(action_indices["move_x"])
+        mx_idx = int(action_indices["move_x"])
+        if obs.globals[1] < 0:  # Dire: canonical frame is x-mirrored
+            mx_idx = move_bins - 1 - mx_idx
+        action.move_x = mx_idx
         action.move_y = int(action_indices["move_y"])
     elif a_type in (pb.ACTION_ATTACK_UNIT, pb.ACTION_CAST):
         slot = int(action_indices["target_unit"])

@@ -81,6 +81,9 @@ class JaxFeaturizer:
         castable = g(hero_castable(state))
 
         my_team = state.team[:, ap][:, :, None]
+        # team-canonical frame: +x points at the enemy tower for BOTH sides
+        # (see features/featurizer.py featurize); actions_to_sim un-mirrors
+        sign = jnp.where(my_team == TEAM_RADIANT, 1.0, -1.0)
         me_x = state.x[:, ap][:, :, None]
         me_y = state.y[:, ap][:, :, None]
         me_alive = state.alive[:, ap]
@@ -91,14 +94,14 @@ class JaxFeaturizer:
         is_tower = unit_type == pb.UNIT_TOWER
         is_ally = (team == my_team) & present
         is_self = jnp.zeros((N, A, S), bool).at[:, :, 0].set(present[:, :, 0])
-        dx = (x - me_x) / F._POS_SCALE
+        dx = (x - me_x) * sign / F._POS_SCALE
         dy = (y - me_y) / F._POS_SCALE
         dist = jnp.hypot(x - me_x, y - me_y)
         deniable = is_ally & ~is_self & is_creep & (health < 0.5 * health_max)
 
         cols = (
             is_hero, is_creep, is_tower, is_ally, present & ~is_ally, is_self,
-            x / F._POS_SCALE, y / F._POS_SCALE, dx, dy, dist / F._POS_SCALE,
+            x * sign / F._POS_SCALE, y / F._POS_SCALE, dx, dy, dist / F._POS_SCALE,
             health / jnp.maximum(health_max, 1.0), health_max / F._HP_SCALE,
             mana / jnp.maximum(mana_max, 1.0),
             g(state.damage) / F._DMG_SCALE,
@@ -207,9 +210,18 @@ class JaxFeaturizer:
         def scatter(col):
             return jnp.full((N, P), -1, jnp.int32).at[:, ap].set(col)
 
+        # canonical → world: Dire lanes mirror the move-x bin back (teams
+        # are static by player index — players ≥ team_size are Dire)
+        mirror = jnp.asarray(
+            [p >= self.spec.team_size for p in self.agent_players]
+        )[None, :]
+        mx = jnp.where(
+            mirror, self.action_spec.move_bins - 1 - packed[..., 1],
+            packed[..., 1],
+        )
         return {
             "type": scatter(packed[..., 0]),
-            "move_x": jnp.zeros((N, P), jnp.int32).at[:, ap].set(packed[..., 1]),
+            "move_x": jnp.zeros((N, P), jnp.int32).at[:, ap].set(mx),
             "move_y": jnp.zeros((N, P), jnp.int32).at[:, ap].set(packed[..., 2]),
             "target_slot": jnp.zeros((N, P), jnp.int32).at[:, ap].set(sim_slot),
             "ability": jnp.zeros((N, P), jnp.int32).at[:, ap].set(packed[..., 4]),
@@ -265,6 +277,8 @@ def shaped_rewards(
     e_hp1 = jnp.where(i_rad, mean_d1[:, None], mean_r1[:, None])
     e_tw0 = jnp.where(i_rad, tower0[:, 1:2], tower0[:, 0:1])
     e_tw1 = jnp.where(i_rad, tower1[:, 1:2], tower1[:, 0:1])
+    o_tw0 = jnp.where(i_rad, tower0[:, 0:1], tower0[:, 1:2])
+    o_tw1 = jnp.where(i_rad, tower1[:, 0:1], tower1[:, 1:2])
 
     def d(field):
         return getattr(cur, field)[:, ap] - getattr(prev, field)[:, ap]
@@ -282,6 +296,7 @@ def shaped_rewards(
         + WEIGHTS["kills"] * d("kills")
         + WEIGHTS["deaths"] * d("deaths")
         + WEIGHTS["tower_damage"] * (e_tw0 - e_tw1)
+        + WEIGHTS["own_tower"] * (o_tw1 - o_tw0)
     )
     just_ended = cur.done & ~prev.done & (cur.winning_team != 0)
     win_sign = jnp.where(cur.winning_team[:, None] == my_team, 1.0, -1.0)

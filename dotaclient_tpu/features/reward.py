@@ -27,6 +27,11 @@ WEIGHTS: Dict[str, float] = {
     "kills": 1.0,
     "deaths": -1.0,
     "tower_damage": 2.0,  # enemy tower hp-fraction lost
+    "own_tower": 2.0,     # OWN tower hp-fraction lost (defense term):
+                          # without it, self-play converges to farming
+                          # with nobody defending, and the timeout
+                          # adjudication (own-tower hp first) is lost
+                          # to any opponent that incidentally defends
     "win": 5.0,
 }
 
@@ -89,6 +94,8 @@ def reward_components(
         "deaths": float((p1.deaths if p1 else 0) - (p0.deaths if p0 else 0)),
         "tower_damage": _tower_hp_frac(prev, enemy_team)
         - _tower_hp_frac(cur, enemy_team),
+        "own_tower": _tower_hp_frac(cur, my_team)
+        - _tower_hp_frac(prev, my_team),
         "win": 0.0,
     }
     if cur.game_state == pb.GAME_STATE_POST_GAME and cur.winning_team:

@@ -98,6 +98,7 @@ class VecFeaturizer:
         castable = g(sim.hero_castable())
 
         my_team = sim.team[:, ap][:, :, None]               # [N, A, 1]
+        sign = np.where(sim.team[:, ap] == 2, 1.0, -1.0)[:, :, None]
         me_x = sim.x[:, ap][:, :, None]
         me_y = sim.y[:, ap][:, :, None]
         me_alive = sim.alive[:, ap]                         # [N, A]
@@ -109,7 +110,7 @@ class VecFeaturizer:
         is_ally = (team == my_team) & present
         is_self = np.zeros((N, A, S), bool)
         is_self[:, :, 0] = present[:, :, 0]
-        dx = (x - me_x) / F._POS_SCALE
+        dx = (x - me_x) * sign / F._POS_SCALE
         dy = (y - me_y) / F._POS_SCALE
         dist = np.hypot(x - me_x, y - me_y)
         deniable = is_ally & ~is_self & is_creep & (health < 0.5 * health_max)
@@ -117,7 +118,7 @@ class VecFeaturizer:
         f = np.zeros((N, A, S, self.obs_spec.unit_features), np.float32)
         cols = (
             is_hero, is_creep, is_tower, is_ally, present & ~is_ally, is_self,
-            x / F._POS_SCALE, y / F._POS_SCALE, dx, dy, dist / F._POS_SCALE,
+            x * sign / F._POS_SCALE, y / F._POS_SCALE, dx, dy, dist / F._POS_SCALE,
             health / np.maximum(health_max, 1.0), health_max / F._HP_SCALE,
             mana / np.maximum(mana_max, 1.0),
             g(sim.damage) / F._DMG_SCALE, g(sim.attack_range) / F._RANGE_SCALE,
@@ -220,7 +221,13 @@ class VecFeaturizer:
         }
         ap = self.agent_players
         out["type"][:, ap] = packed[..., 0]
-        out["move_x"][:, ap] = packed[..., 1]
+        # canonical → world frame: Dire lanes mirror the move-x bin (the
+        # featurizer mirrored their observations; see featurize)
+        mirror = sim.team[:, ap] != 2
+        mx = packed[..., 1]
+        out["move_x"][:, ap] = np.where(
+            mirror, self.action_spec.move_bins - 1 - mx, mx
+        )
         out["move_y"][:, ap] = packed[..., 2]
         # obs slot → sim slot
         obs_slot = np.clip(packed[..., 3], 0, spec.max_units - 1)
@@ -310,6 +317,8 @@ class VecRewards:
         enemy_hp_cur = np.where(i_rad, cur["mean_hp_dire"][:, None], cur["mean_hp_rad"][:, None])
         enemy_tower_prev = np.where(i_rad, prev["tower"][:, 1:2], prev["tower"][:, 0:1])
         enemy_tower_cur = np.where(i_rad, cur["tower"][:, 1:2], cur["tower"][:, 0:1])
+        own_tower_prev = np.where(i_rad, prev["tower"][:, 0:1], prev["tower"][:, 1:2])
+        own_tower_cur = np.where(i_rad, cur["tower"][:, 0:1], cur["tower"][:, 1:2])
 
         r = (
             WEIGHTS["xp"] * (cur["xp"] - prev["xp"])
@@ -321,6 +330,7 @@ class VecRewards:
             + WEIGHTS["kills"] * (cur["kills"] - prev["kills"])
             + WEIGHTS["deaths"] * (cur["deaths"] - prev["deaths"])
             + WEIGHTS["tower_damage"] * (enemy_tower_prev - enemy_tower_cur)
+            + WEIGHTS["own_tower"] * (own_tower_cur - own_tower_prev)
         )
         # only the step the game ends pays the win term (done stays True
         # until the runtime resets the game)

@@ -8,9 +8,15 @@ opponents"). Mechanics here:
   (device-to-device copy — snapshots never touch the host) into a bounded
   ring of ``pool_size`` frozen opponents;
 * each opponent draw plays the LATEST policy (mirror self-play) with
-  probability ``selfplay_prob``, otherwise a uniformly random frozen
-  snapshot — the standard league mix that stops strategy collapse while
-  keeping most experience near on-policy.
+  probability ``selfplay_prob``, otherwise a frozen snapshot;
+* snapshot selection is governed by ``LeagueConfig.matchmaking``:
+  - ``"uniform"`` — the classic uniform draw;
+  - ``"pfsp"`` — prioritized fictitious self-play: the pool tracks the
+    learner's win-rate against each snapshot (callers attribute outcomes
+    via :meth:`report`) and weights draws by ``f(w) = (1-w)^p`` — hard
+    opponents are replayed until beaten, which is the standard cure for
+    the uniform-league failure mode where the learner over-trains on easy
+    past selves, then collapses when a strong snapshot enters the pool.
 """
 
 from __future__ import annotations
@@ -24,22 +30,40 @@ import numpy as np
 
 from dotaclient_tpu.config import LeagueConfig
 
+LIVE = -1  # sentinel opponent id for the live (mirror self-play) draw
+
 
 @dataclasses.dataclass
 class Snapshot:
     params: Any
     version: int
     step: int
+    uid: int = 0               # stable id — survives ring eviction shifts
+    # PFSP bookkeeping: learner outcomes vs this snapshot (EMA-free counts;
+    # the win-rate estimate is games-weighted so early noise washes out)
+    games: float = 0.0
+    wins: float = 0.0
+
+    @property
+    def win_rate(self) -> float:
+        """Learner's win-rate vs this snapshot (0.5 prior until played)."""
+        return self.wins / self.games if self.games > 0 else 0.5
 
 
 class OpponentPool:
     """Bounded ring of frozen policy snapshots + opponent sampling."""
 
     def __init__(self, config: LeagueConfig, seed: int = 0) -> None:
+        if config.matchmaking not in ("uniform", "pfsp"):
+            raise ValueError(
+                f"unknown matchmaking {config.matchmaking!r} "
+                "(expected 'uniform' or 'pfsp')"
+            )
         self.config = config
         self.snapshots: List[Snapshot] = []
         self._rng = np.random.default_rng(seed)
         self._last_snapshot_step: Optional[int] = None
+        self._next_uid = 0
 
     def __len__(self) -> int:
         return len(self.snapshots)
@@ -55,16 +79,56 @@ class OpponentPool:
         ):
             return False
         frozen = jax.tree.map(jnp.copy, params)
-        self.snapshots.append(Snapshot(frozen, version, step))
+        self.snapshots.append(Snapshot(frozen, version, step, uid=self._next_uid))
+        self._next_uid += 1
         if len(self.snapshots) > self.config.pool_size:
             self.snapshots.pop(0)
         self._last_snapshot_step = step
         return True
 
-    def sample(self, live_params: Any, live_version: int) -> Tuple[Any, int]:
-        """Draw the opponent for the next rollout batch: the live policy with
-        probability ``selfplay_prob``, else a uniform frozen snapshot."""
+    def _pfsp_weights(self) -> np.ndarray:
+        """(1 - win_rate)^power per snapshot, floored so no opponent is
+        starved (a beaten opponent must stay in rotation to detect
+        forgetting)."""
+        w = np.asarray(
+            [(1.0 - s.win_rate) ** self.config.pfsp_power for s in self.snapshots]
+        )
+        w = np.maximum(w, 0.05)
+        return w / w.sum()
+
+    def sample_indexed(
+        self, live_params: Any, live_version: int
+    ) -> Tuple[Any, int, int]:
+        """Draw the opponent for the next rollout batch → (params, version,
+        uid). ``uid`` is ``LIVE`` for the mirror self-play draw, else the
+        snapshot's STABLE id for outcome attribution via :meth:`report`
+        (stable: ring eviction shifts list positions, never uids).
+        """
         if not self.snapshots or self._rng.random() < self.config.selfplay_prob:
-            return live_params, live_version
-        snap = self.snapshots[self._rng.integers(len(self.snapshots))]
-        return snap.params, snap.version
+            return live_params, live_version, LIVE
+        if self.config.matchmaking == "pfsp":
+            idx = int(self._rng.choice(len(self.snapshots), p=self._pfsp_weights()))
+        else:
+            idx = int(self._rng.integers(len(self.snapshots)))
+        snap = self.snapshots[idx]
+        return snap.params, snap.version, snap.uid
+
+    def sample(self, live_params: Any, live_version: int) -> Tuple[Any, int]:
+        params, version, _ = self.sample_indexed(live_params, live_version)
+        return params, version
+
+    def report(self, uid: int, wins: float, games: float) -> None:
+        """Attribute ``games`` learner-vs-snapshot outcomes (``wins`` won by
+        the learner) to the snapshot with stable id ``uid``. No-op for
+        ``LIVE`` draws and for snapshots evicted since the draw."""
+        if uid == LIVE or games <= 0:
+            return
+        for s in self.snapshots:
+            if s.uid == uid:
+                s.games += games
+                s.wins += wins
+                return
+
+    def win_rates(self) -> List[float]:
+        """Learner win-rate per snapshot (diagnostics / metrics)."""
+        return [s.win_rate for s in self.snapshots]

@@ -41,6 +41,7 @@ import numpy as np
 from collections import deque
 
 from dotaclient_tpu.buffer import TrajectoryBuffer
+from dotaclient_tpu.league import pool as league_pool
 from dotaclient_tpu.config import RunConfig, default_config
 from dotaclient_tpu.actor import ActorPool, VecActorPool
 from dotaclient_tpu.models import init_params, make_policy
@@ -188,6 +189,9 @@ class Learner:
         # step 7). Seeded from the initial params so opponent lanes are
         # frozen from step 0, never silently mirroring the live policy.
         self.league = None
+        self._league_pending: List[Any] = []
+        self._held_opponent = None      # (params|None, uid) held draw
+        self._held_until = -1
         if config.env.opponent == "league":
             if mode == "scalar":
                 raise NotImplementedError(
@@ -206,6 +210,14 @@ class Learner:
                 self.pool.set_opponent(
                     *self.league.sample(self._actor_params_copy(), 0)
                 )
+                if config.league.matchmaking == "pfsp":
+                    print(
+                        "WARNING: PFSP matchmaking needs per-draw outcome "
+                        "attribution, which only the device/fused loops "
+                        "provide; host-pool league draws keep the 0.5 "
+                        "prior and behave as uniform",
+                        flush=True,
+                    )
         self.metrics = MetricsLogger(logdir)
         self.frames_per_rollout = config.ppo.rollout_len
         # Minibatch machinery: one jitted gather (a tree of row-gathers is
@@ -351,18 +363,58 @@ class Learner:
         )
 
     def _league_opponent(self):
-        """Snapshot-if-due and draw the frozen opponent's params for the
-        device/fused loops. None when no league is configured (self-play /
-        scripted opponents)."""
+        """Snapshot-if-due and return the current frozen opponent for the
+        device/fused loops → (params | None, snapshot uid). Draws are HELD
+        for ``league.opponent_hold`` optimizer steps: episodes span many
+        chunks, so holding keeps (most of) each episode against one
+        opponent — without it the per-chunk outcome attribution PFSP feeds
+        on dilutes toward the pool average. Residual bias: episodes that
+        straddle a redraw credit their final opponent."""
         if self.league is None:
-            return None
+            return None, league_pool.LIVE
         self.league.maybe_snapshot(
             self.state.params, self._host_version, self._host_step
         )
-        params, _ = self.league.sample(
-            self.state.params, self._host_version
-        )
-        return params
+        if (
+            self._held_opponent is None
+            or self._host_step >= self._held_until
+        ):
+            params, _, uid = self.league.sample_indexed(
+                self.state.params, self._host_version
+            )
+            # LIVE draws are never cached: the buffered path donates the
+            # train state every step, so held live params would be dead
+            # buffers by the next iteration — re-resolve them per call.
+            self._held_opponent = (
+                None if uid == league_pool.LIVE else params, uid
+            )
+            self._held_until = (
+                self._host_step + self.config.league.opponent_hold
+            )
+        params, uid = self._held_opponent
+        if uid == league_pool.LIVE:
+            params = self.state.params
+        return params, uid
+
+    def _report_league(self, idx: int, chunk_stats) -> None:
+        """Queue one chunk's (device-resident) episode outcomes against the
+        snapshot that produced them; resolved in batches at log boundaries
+        so the hot loop never syncs."""
+        if self.league is None or idx == league_pool.LIVE:
+            return
+        self._league_pending.append((idx, chunk_stats))
+        if len(self._league_pending) >= 64:
+            self._flush_league_reports()
+
+    def _flush_league_reports(self) -> None:
+        if not self._league_pending:
+            return
+        pending, self._league_pending = self._league_pending, []
+        fetched = jax.device_get([st for _, st in pending])  # one sync
+        for (idx, _), st in zip(pending, fetched):
+            self.league.report(
+                idx, float(st["wins"]), float(st["episodes"])
+            )
 
     def _refresh_league_opponent(self) -> None:
         """Snapshot-if-due and re-draw the frozen opponent (host-pool modes;
@@ -415,6 +467,12 @@ class Learner:
                     scalars.update(self.device_actor.drain_stats())
                 elif self.pool is not None:
                     scalars.update(self.pool.stats())
+                if self.league is not None:
+                    self._flush_league_reports()
+                    wrs = self.league.win_rates()
+                    scalars["league_snapshots"] = float(len(wrs))
+                    if wrs:
+                        scalars["league_winrate_mean"] = float(np.mean(wrs))
                 if self.buffer is not None:
                     scalars.update(self.buffer.metrics())
                 elapsed = time.time() - t_start
@@ -436,12 +494,13 @@ class Learner:
             da = self.device_actor
             frames_per = da.n_lanes * cfg.ppo.rollout_len
             while steps_done < num_steps:
-                opp_params = self._league_opponent()
+                opp_params, opp_idx = self._league_opponent()
                 if opp_params is None:       # self-play / scripted: one
                     opp_params = self.state.params   # signature for all modes
-                self.state, da.state, m, _ = self.fused_step(
+                self.state, da.state, m, chunk_stats = self.fused_step(
                     self.state, da.state, opp_params
                 )
+                self._report_league(opp_idx, chunk_stats)
                 self._host_step += 1
                 self._host_version += 1
                 da.env_steps += frames_per
@@ -454,8 +513,11 @@ class Learner:
             # so a host thread would add nothing; `overlap` is a no-op here).
             da = self.device_actor
             while steps_done < num_steps:
-                opp_params = self._league_opponent()
-                chunk, _ = da.collect(self.state.params, opp_params=opp_params)
+                opp_params, opp_idx = self._league_opponent()
+                chunk, chunk_stats = da.collect(
+                    self.state.params, opp_params=opp_params
+                )
+                self._report_league(opp_idx, chunk_stats)
                 self.buffer.add_device(chunk, self._host_version)
                 while (
                     batch := self.buffer.take(
@@ -542,6 +604,8 @@ class Learner:
                         break
         if self.device_actor is not None:
             self.device_actor.drain_stats()
+        if self.league is not None:
+            self._flush_league_reports()
         # Publish final weights for out-of-process actors (cluster parity).
         self._publish_weights()
         if self.ckpt:
@@ -629,6 +693,10 @@ def main(argv=None) -> Dict[str, float]:
                    help="ICI-connected slices bridged over DCN (mesh axis)")
     p.add_argument("--model-parallel", type=int, default=None,
                    help="tensor-parallel width (model mesh axis)")
+    p.add_argument("--compile-cache", type=str, default=None, metavar="DIR",
+                   help="persistent XLA compilation cache directory: the "
+                   "fused/train programs compile once per machine instead "
+                   "of once per process (~20-40s saved on restart)")
     args = p.parse_args(argv)
     if args.transport != "inproc" and args.actor is None:
         args.actor = "external"
@@ -639,6 +707,9 @@ def main(argv=None) -> Dict[str, float]:
 
         initialize_runtime()
         print(f"learner: distributed runtime up: {process_info()}", flush=True)
+    if args.compile_cache:
+        jax.config.update("jax_compilation_cache_dir", args.compile_cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     config = default_config()
     mesh_over = {}

@@ -32,6 +32,59 @@ def small_config(**env_kw):
     )
 
 
+class TestPfsp:
+    def _pool(self, **kw):
+        cfg = LeagueConfig(
+            pool_size=4, snapshot_every=1, selfplay_prob=0.0,
+            matchmaking="pfsp", **kw,
+        )
+        pool = OpponentPool(cfg, seed=0)
+        for i in range(3):
+            pool.maybe_snapshot({"w": jnp.full((2,), float(i))}, i, i)
+        return pool
+
+    def test_report_attributes_outcomes(self):
+        pool = self._pool()
+        pool.report(0, wins=9, games=10)
+        pool.report(2, wins=1, games=10)
+        assert pool.win_rates() == pytest.approx([0.9, 0.5, 0.1])
+        # LIVE draws and evicted indices are no-ops, never errors
+        from dotaclient_tpu.league.pool import LIVE
+
+        pool.report(LIVE, 5, 5)
+        pool.report(99, 5, 5)
+        assert pool.win_rates() == pytest.approx([0.9, 0.5, 0.1])
+
+    def test_pfsp_prefers_hard_opponents(self):
+        pool = self._pool()
+        pool.report(0, wins=98, games=100)   # beaten → rarely drawn
+        pool.report(2, wins=2, games=100)    # hard → drawn often
+        counts = [0, 0, 0]
+        for _ in range(600):
+            _, _, idx = pool.sample_indexed({"w": jnp.zeros(2)}, 0)
+            counts[idx] += 1
+        assert counts[2] > counts[1] > counts[0]
+        # starvation floor: the beaten snapshot still appears (forgetting
+        # detection)
+        assert counts[0] > 0
+
+    def test_uniform_matchmaking_ignores_outcomes(self):
+        cfg = LeagueConfig(
+            pool_size=4, snapshot_every=1, selfplay_prob=0.0,
+            matchmaking="uniform",
+        )
+        pool = OpponentPool(cfg, seed=0)
+        for i in range(3):
+            pool.maybe_snapshot({"w": jnp.full((2,), float(i))}, i, i)
+        pool.report(0, wins=100, games=100)
+        counts = [0, 0, 0]
+        for _ in range(900):
+            _, _, idx = pool.sample_indexed({"w": jnp.zeros(2)}, 0)
+            counts[idx] += 1
+        for c in counts:
+            assert 200 < c < 400   # ~uniform thirds
+
+
 class TestOpponentPool:
     def _params(self, val=0.0):
         return {"w": jnp.full((4,), val, jnp.float32)}
