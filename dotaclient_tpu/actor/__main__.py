@@ -34,6 +34,10 @@ def main(argv=None) -> int:
     p.add_argument("--n-envs", type=int, default=64)
     p.add_argument("--opponent", type=str, default="scripted_easy")
     p.add_argument("--team-size", type=int, default=1)
+    p.add_argument("--rollout-len", type=int, default=None,
+                   help="chunk length T; MUST match the learner's "
+                        "ppo.rollout_len (e.g. 8 for a --smoke learner) — "
+                        "skewed chunks are dropped at the learner's buffer")
     p.add_argument("--seed", type=int, default=None,
                    help="rollout RNG seed; default derives from $POD_NAME "
                         "(unique per k8s replica) or 0 outside k8s")
@@ -86,6 +90,12 @@ def main(argv=None) -> int:
             team_size=args.team_size,
         ),
     )
+    if args.rollout_len is not None:
+        config = dataclasses.replace(
+            config, ppo=dataclasses.replace(
+                config.ppo, rollout_len=args.rollout_len
+            )
+        )
     policy = make_policy(config.model, config.obs, config.actions)
 
     # Wait for the learner's first weights broadcast — rollouts from random

@@ -34,7 +34,12 @@ from dotaclient_tpu.features.vec_featurizer import VecFeaturizer, VecRewards
 from dotaclient_tpu.models import distributions as D
 from dotaclient_tpu.models.policy import Policy
 from dotaclient_tpu.protos import dota_pb2 as pb
-from dotaclient_tpu.transport import Transport, decode_weights, encode_rollout
+from dotaclient_tpu.transport import (
+    Transport,
+    decode_weights,
+    encode_rollout,
+    encode_rollout_bytes,
+)
 
 DecodedRollout = Tuple[Dict[str, Any], Any]
 
@@ -307,10 +312,18 @@ class VecActorPool:
         if self.rollout_sink is not None:
             self.rollout_sink(out)
         elif self.transport is not None:
+            # wire fast path: C encoder straight from the numpy buffers when
+            # the transport ships bytes (socket/AMQP); in-proc passes protos
+            publish_bytes = getattr(
+                self.transport, "publish_rollout_bytes", None
+            )
             for meta, arrays in out:
-                self.transport.publish_rollout(
-                    encode_rollout(arrays, **meta)
-                )
+                if publish_bytes is not None:
+                    publish_bytes(encode_rollout_bytes(arrays, **meta))
+                else:
+                    self.transport.publish_rollout(
+                        encode_rollout(arrays, **meta)
+                    )
         self.rollouts_shipped += len(out)
 
     def _record_episodes(self, games: np.ndarray) -> None:

@@ -88,7 +88,17 @@ class TrajectoryBuffer:
         self._slot_version = np.zeros((cap,), np.int64)
         self.dropped_stale = 0
         self.dropped_overflow = 0
+        self.dropped_skew = 0
         self.ingested = 0
+        # Per-slot leaf spec for the ingest-door shape guard: a rollout from
+        # a config-skewed actor (different rollout_len / obs shapes / model
+        # core) must be dropped like any other malformed payload — actors
+        # are disposable, the learner is not (SURVEY.md §5.3).
+        self._tmpl_struct = jax.tree.structure(template)
+        self._tmpl_leaves = [
+            (x.shape[1:], np.dtype(x.dtype)) for x in jax.tree.leaves(template)
+        ]
+        self._skew_warned = False
 
         self._scatter = jax.jit(
             lambda store, rows, idx: jax.tree.map(
@@ -132,6 +142,18 @@ class TrajectoryBuffer:
             if current_version - meta["model_version"] > self._staleness_limit:
                 self.dropped_stale += 1
                 continue
+            if not self._matches_slot(arrays):
+                self.dropped_skew += 1
+                if not self._skew_warned:
+                    self._skew_warned = True
+                    print(
+                        "trajectory_buffer: dropping rollout whose shapes do "
+                        "not match this learner's config (actor running a "
+                        "different rollout_len/obs/model config?) — align "
+                        "actor and learner configs",
+                        flush=True,
+                    )
+                continue
             fresh.append((meta, arrays))
         if len(fresh) > self.capacity:
             # A single scatter must not contain duplicate slot indices (the
@@ -172,6 +194,20 @@ class TrajectoryBuffer:
         self._order.extend(slots)
         self.ingested += len(fresh)
         return len(fresh)
+
+    def _matches_slot(self, arrays: Any) -> bool:
+        """True iff ``arrays`` has exactly the slot pytree/shape/dtype."""
+        try:
+            if jax.tree.structure(arrays) != self._tmpl_struct:
+                return False
+            return all(
+                np.shape(leaf) == shape and np.asarray(leaf).dtype == dtype
+                for leaf, (shape, dtype) in zip(
+                    jax.tree.leaves(arrays), self._tmpl_leaves
+                )
+            )
+        except (TypeError, ValueError, AttributeError):
+            return False
 
     def add_device(self, chunk: Dict[str, Any], version: int) -> int:
         """Ingest a device-resident chunk batch (arrays ``[L, T, ...]``, the
@@ -299,4 +335,5 @@ class TrajectoryBuffer:
             "buffer_ingested": float(self.ingested),
             "buffer_dropped_stale": float(self.dropped_stale),
             "buffer_dropped_overflow": float(self.dropped_overflow),
+            "buffer_dropped_skew": float(self.dropped_skew),
         }

@@ -21,21 +21,22 @@ _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
 
 
-def build(force: bool = False) -> str:
+def build(force: bool = False, out: Optional[str] = None) -> str:
     """Compile the shared library if missing/stale; returns its path."""
+    out = out or _LIB
     with _lock:
         if (
             not force
-            and os.path.exists(_LIB)
-            and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)
+            and os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(_SRC)
         ):
-            return _LIB
+            return out
         cmd = [
             "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-            "-o", _LIB, _SRC,
+            "-o", out, _SRC,
         ]
         subprocess.run(cmd, check=True, capture_output=True)
-        return _LIB
+        return out
 
 
 class TensorEntry(ctypes.Structure):
@@ -55,6 +56,15 @@ class RolloutHeader(ctypes.Structure):
     ]
 
 
+class EncodeTensor(ctypes.Structure):
+    _fields_ = [
+        ("name_off", ctypes.c_uint32), ("name_len", ctypes.c_uint32),
+        ("dtype_off", ctypes.c_uint32), ("dtype_len", ctypes.c_uint32),
+        ("data_ptr", ctypes.c_uint64), ("data_len", ctypes.c_uint64),
+        ("shape", ctypes.c_int32 * 8), ("ndim", ctypes.c_int32),
+    ]
+
+
 def load_library(auto_build: bool = True) -> Optional[ctypes.CDLL]:
     """Load (building if needed) the native library; None if unavailable."""
     global _lib, _load_failed
@@ -64,14 +74,44 @@ def load_library(auto_build: bool = True) -> Optional[ctypes.CDLL]:
         if auto_build:
             build()
         lib = ctypes.CDLL(_LIB)
+        if auto_build and not hasattr(lib, "dota_encode_rollout"):
+            # Stale artifact with equal mtimes (image COPY, tarball): the
+            # mtime check skipped the rebuild but the symbol set is old —
+            # and dlopen caches by file, so rebuilding onto the SAME path
+            # cannot refresh this process's handle. Compile to a fresh
+            # path, load that, and promote it for future processes; if the
+            # rebuild fails, keep the stale handle (decode still works —
+            # the encode wrapper probes for its symbol before use).
+            fresh = f"{_LIB}.fresh.{os.getpid()}"
+            try:
+                build(force=True, out=fresh)
+                lib = ctypes.CDLL(fresh)
+                os.replace(fresh, _LIB)
+            except (OSError, subprocess.CalledProcessError):
+                try:
+                    os.unlink(fresh)
+                except OSError:
+                    pass
         lib.dota_decode_rollout.restype = ctypes.c_int32
         lib.dota_decode_rollout.argtypes = [
             ctypes.c_char_p, ctypes.c_uint64,
             ctypes.POINTER(RolloutHeader),
             ctypes.POINTER(TensorEntry), ctypes.c_int32,
         ]
+        if hasattr(lib, "dota_encode_rollout"):  # absent on a stale handle
+            lib.dota_encode_rollout.restype = ctypes.c_int64
+            lib.dota_encode_rollout.argtypes = [
+                ctypes.POINTER(RolloutHeader), ctypes.c_char_p,
+                ctypes.POINTER(EncodeTensor), ctypes.c_int32,
+                ctypes.c_void_p, ctypes.c_uint64,
+            ]
         _lib = lib
-    except (OSError, subprocess.CalledProcessError, FileNotFoundError):
+    except (
+        OSError,
+        subprocess.CalledProcessError,
+        FileNotFoundError,
+        AttributeError,  # unbuildable stale library missing a symbol
+    ):
         _load_failed = True
         _lib = None
     return _lib

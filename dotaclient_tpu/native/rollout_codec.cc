@@ -1,9 +1,11 @@
-// Fast-path rollout wire decoder (SURVEY.md §2.2 row 3).
+// Fast-path rollout wire codec (SURVEY.md §2.2 row 3).
 //
 // The reference's native surface for experience transport was protobuf's C++
-// runtime under the Python bindings; here the hot direction — broker bytes →
-// tensor views on the learner host — is a first-party, allocation-free wire
-// parser for the `Rollout` message of dotaclient_tpu/protos/dota.proto:
+// runtime under the Python bindings; here BOTH hot directions are first-party
+// single-pass wire code: decode (broker bytes → tensor views on the learner
+// host, allocation-free) and encode (actor-side numpy buffers → wire bytes,
+// one memcpy per tensor, no python-protobuf object tree). The message is the
+// `Rollout` of dotaclient_tpu/protos/dota.proto:
 //
 //   message TensorProto { repeated int32 shape = 1; string dtype = 2;
 //                         bytes data = 3; }
@@ -180,6 +182,153 @@ int32_t dota_decode_rollout(const uint8_t* buf, uint64_t buf_len,
     }
   }
   return c.ok ? count : -1;
+}
+
+// ---------------------------------------------------------------------------
+// Encoder: the actor→learner direction. Python hands one EncodeTensor per
+// flattened pytree leaf (pointers into live numpy buffers — zero staging
+// copies); the writer emits proto3 wire format that python-protobuf (and the
+// decoder above) parse identically. Scalar header fields follow proto3
+// default-omission, so byte streams match python-protobuf's own encoding of
+// the same message modulo map-entry order (maps are unordered by contract).
+
+// Filled on the Python side as ONE numpy structured array (per-field ctypes
+// assignment is ~10x the cost of the whole C call); names/dtypes are offsets
+// into a single concatenated strings blob, tensor payloads raw addresses of
+// the (pinned) numpy buffers.
+struct EncodeTensor {
+  uint32_t name_off, name_len;    // into `strings`
+  uint32_t dtype_off, dtype_len;  // into `strings`
+  uint64_t data_ptr, data_len;    // raw buffer address
+  int32_t shape[8];
+  int32_t ndim;
+};
+
+namespace {
+
+inline uint32_t varint_size(uint64_t v) {
+  uint32_t n = 1;
+  while (v >= 0x80) { v >>= 7; ++n; }
+  return n;
+}
+
+struct Writer {
+  uint8_t* p;
+
+  void varint(uint64_t v) {
+    while (v >= 0x80) { *p++ = static_cast<uint8_t>(v) | 0x80; v >>= 7; }
+    *p++ = static_cast<uint8_t>(v);
+  }
+  void tag(uint32_t field, uint32_t wire_type) {
+    varint((static_cast<uint64_t>(field) << 3) | wire_type);
+  }
+  void bytes(const uint8_t* src, uint64_t n) {
+    std::memcpy(p, src, n);
+    p += n;
+  }
+};
+
+// Sizes of the variable-length pieces, computed once and reused by the
+// writer so the output is laid down in one forward pass.
+struct TensorSizes {
+  uint64_t shape_payload;  // packed varints of the dims
+  uint64_t tensor_body;    // TensorProto body (shape + dtype + data fields)
+  uint64_t entry_body;     // map-entry body (key + value fields)
+};
+
+void tensor_sizes(const EncodeTensor& t, TensorSizes* s) {
+  s->shape_payload = 0;
+  for (int32_t i = 0; i < t.ndim; ++i)
+    s->shape_payload += varint_size(static_cast<uint64_t>(
+        static_cast<int64_t>(t.shape[i])));
+  s->tensor_body = 0;
+  if (t.ndim > 0)
+    s->tensor_body += 1 + varint_size(s->shape_payload) + s->shape_payload;
+  s->tensor_body += 1 + varint_size(t.dtype_len) + t.dtype_len;
+  s->tensor_body += 1 + varint_size(t.data_len) + t.data_len;
+  s->entry_body = 1 + varint_size(t.name_len) + t.name_len +
+                  1 + varint_size(s->tensor_body) + s->tensor_body;
+}
+
+}  // namespace
+
+// Encode a Rollout. Returns the exact number of bytes required; the output
+// is written only when `cap` is sufficient (call once with cap=0 to size, or
+// overprovision and accept the returned length). Returns -1 on invalid
+// input (ndim out of range).
+int64_t dota_encode_rollout(const RolloutHeader* hdr, const uint8_t* strings,
+                            const EncodeTensor* tensors, int32_t n_tensors,
+                            uint8_t* out, uint64_t cap) {
+  uint64_t need = 0;
+  if (hdr->model_version != 0)
+    need += 1 + varint_size(static_cast<uint64_t>(
+        static_cast<int64_t>(hdr->model_version)));
+  if (hdr->env_id != 0)
+    need += 1 + varint_size(static_cast<uint64_t>(
+        static_cast<int64_t>(hdr->env_id)));
+  if (hdr->rollout_id != 0) need += 1 + varint_size(hdr->rollout_id);
+  if (hdr->length != 0)
+    need += 1 + varint_size(static_cast<uint64_t>(
+        static_cast<int64_t>(hdr->length)));
+  if (hdr->total_reward != 0.0f) need += 1 + 4;
+  for (int32_t i = 0; i < n_tensors; ++i) {
+    if (tensors[i].ndim < 0 || tensors[i].ndim > 8) return -1;
+    TensorSizes s;
+    tensor_sizes(tensors[i], &s);
+    need += 1 + varint_size(s.entry_body) + s.entry_body;
+  }
+  if (need > cap) return static_cast<int64_t>(need);
+
+  Writer w{out};
+  // proto3 varints encode negative int32 as 10-byte two's complement; the
+  // int64_t casts above/below reproduce that (header ids are never negative
+  // in practice, but wire compatibility should not depend on it).
+  if (hdr->model_version != 0) {
+    w.tag(1, 0);
+    w.varint(static_cast<uint64_t>(static_cast<int64_t>(hdr->model_version)));
+  }
+  if (hdr->env_id != 0) {
+    w.tag(2, 0);
+    w.varint(static_cast<uint64_t>(static_cast<int64_t>(hdr->env_id)));
+  }
+  if (hdr->rollout_id != 0) {
+    w.tag(3, 0);
+    w.varint(hdr->rollout_id);
+  }
+  if (hdr->length != 0) {
+    w.tag(4, 0);
+    w.varint(static_cast<uint64_t>(static_cast<int64_t>(hdr->length)));
+  }
+  if (hdr->total_reward != 0.0f) {
+    w.tag(5, 5);
+    std::memcpy(w.p, &hdr->total_reward, 4);
+    w.p += 4;
+  }
+  for (int32_t i = 0; i < n_tensors; ++i) {
+    const EncodeTensor& t = tensors[i];
+    TensorSizes s;
+    tensor_sizes(t, &s);
+    w.tag(6, 2);                       // map entry
+    w.varint(s.entry_body);
+    w.tag(1, 2);                       // key
+    w.varint(t.name_len);
+    w.bytes(strings + t.name_off, t.name_len);
+    w.tag(2, 2);                       // value: TensorProto
+    w.varint(s.tensor_body);
+    if (t.ndim > 0) {
+      w.tag(1, 2);                     // packed shape
+      w.varint(s.shape_payload);
+      for (int32_t d = 0; d < t.ndim; ++d)
+        w.varint(static_cast<uint64_t>(static_cast<int64_t>(t.shape[d])));
+    }
+    w.tag(2, 2);                       // dtype
+    w.varint(t.dtype_len);
+    w.bytes(strings + t.dtype_off, t.dtype_len);
+    w.tag(3, 2);                       // data
+    w.varint(t.data_len);
+    w.bytes(reinterpret_cast<const uint8_t*>(t.data_ptr), t.data_len);
+  }
+  return static_cast<int64_t>(w.p - out);
 }
 
 }  // extern "C"

@@ -11,8 +11,10 @@ from dotaclient_tpu.transport.socket_transport import (
 )
 from dotaclient_tpu.transport.serialize import (
     decode_rollout,
+    decode_rollout_bytes,
     decode_weights,
     encode_rollout,
+    encode_rollout_bytes,
     encode_weights,
     flatten_tree,
     proto_to_tensor,
@@ -27,8 +29,10 @@ __all__ = [
     "Transport",
     "TransportServer",
     "decode_rollout",
+    "decode_rollout_bytes",
     "decode_weights",
     "encode_rollout",
+    "encode_rollout_bytes",
     "encode_weights",
     "flatten_tree",
     "proto_to_tensor",

@@ -126,11 +126,15 @@ class AmqpTransport:
             exchange=self.WEIGHTS_EXCHANGE, queue=self._weights_queue
         )
 
-    def publish_rollout(self, rollout: pb.Rollout) -> None:  # pragma: no cover
+    def publish_rollout(self, rollout: pb.Rollout) -> None:
+        self.publish_rollout_bytes(rollout.SerializeToString())
+
+    def publish_rollout_bytes(self, payload) -> None:
+        """Ship pre-serialized wire bytes (the native-encoder fast path)."""
         self._ch.basic_publish(
             exchange="",
             routing_key=self.EXPERIENCE_QUEUE,
-            body=rollout.SerializeToString(),
+            body=bytes(payload),  # pika requires real bytes
         )
 
     def consume_rollouts(

@@ -137,7 +137,19 @@ _ENTRY_DTYPE = np.dtype(
         ("shape", "<i4", (8,)), ("ndim", "<i4"),
     ]
 )
+# encoder-side mirror of the C EncodeTensor struct (align=True matches the
+# C++ compiler's layout; asserted against ctypes.sizeof at first use)
+_ENC_DTYPE = np.dtype(
+    [
+        ("name_off", "<u4"), ("name_len", "<u4"),
+        ("dtype_off", "<u4"), ("dtype_len", "<u4"),
+        ("data_ptr", "<u8"), ("data_len", "<u8"),
+        ("shape", "<i4", (8,)), ("ndim", "<i4"),
+    ],
+    align=True,
+)
 _DTYPE_CACHE: Dict[bytes, np.dtype] = {}
+_SPEC_CACHE: Dict[tuple, tuple] = {}
 _tls = threading.local()
 
 
@@ -161,6 +173,8 @@ def decode_rollout_bytes(
     read-only — callers that mutate must copy (the trajectory buffer only
     uploads, so the hot path never does).
     """
+    if not isinstance(payload, bytes):
+        payload = bytes(payload)  # bytes-like in (e.g. encoder memoryview)
     if native:
         from dotaclient_tpu.native.build import (
             RolloutHeader,
@@ -209,6 +223,99 @@ def decode_rollout_bytes(
     r = pb.Rollout()
     r.ParseFromString(payload)
     return decode_rollout(r)
+
+
+def encode_rollout_bytes(
+    arrays: Any,
+    model_version: int,
+    env_id: int,
+    rollout_id: int,
+    length: int,
+    total_reward: float,
+    native: bool = True,
+) -> "bytes | memoryview":
+    """Serialize one rollout straight to wire bytes (bytes-like).
+
+    The actor-ship fast path, mirror of :func:`decode_rollout_bytes`: with
+    the native library built, one C pass writes the proto3 wire format
+    directly from the numpy buffers (one memcpy per tensor, no
+    python-protobuf object tree — the reference paid this cost through
+    protobuf's C++ runtime, SURVEY.md §2.2 row 3). Output parses
+    identically to ``encode_rollout(...).SerializeToString()``; falls back
+    to that when the library is unavailable (or a tensor exceeds 8 dims).
+    """
+    if native:
+        from dotaclient_tpu.native.build import (
+            EncodeTensor,
+            RolloutHeader,
+            load_library,
+        )
+
+        lib = load_library()
+        if lib is not None and hasattr(lib, "dota_encode_rollout"):
+            assert _ENC_DTYPE.itemsize == ctypes.sizeof(EncodeTensor)
+            flat = flatten_tree(arrays)
+            if all(a.ndim <= 8 for a in flat.values()):
+                n = len(flat)
+                arrs = [np.ascontiguousarray(a) for a in flat.values()]
+                # Rollout structure is fixed across an actor's lifetime, so
+                # everything but the data pointers — the EncodeTensor table,
+                # the names/dtypes blob, the size bound — is cached per
+                # (names, dtypes, shapes) key; the steady-state cost per call
+                # is one column write plus the C pass.
+                key = tuple(
+                    (name, _dtype_name(a.dtype), a.shape)
+                    for name, a in zip(flat, arrs)
+                )
+                cached = _SPEC_CACHE.get(key)
+                if cached is None:
+                    specs = np.zeros(n, _ENC_DTYPE)
+                    pieces = []
+                    pos = 0
+                    cap = 64
+                    for i, (name, dtype_name, shape) in enumerate(key):
+                        nb, db = name.encode(), dtype_name.encode()
+                        pieces += [nb, db]
+                        specs["name_off"][i] = pos
+                        specs["name_len"][i] = len(nb)
+                        specs["dtype_off"][i] = pos + len(nb)
+                        specs["dtype_len"][i] = len(db)
+                        pos += len(nb) + len(db)
+                        specs["data_len"][i] = arrs[i].nbytes
+                        specs["shape"][i, : len(shape)] = shape
+                        specs["ndim"][i] = len(shape)
+                        cap += arrs[i].nbytes + len(nb) + len(db) + 128
+                    cached = (specs, b"".join(pieces), cap)
+                    _SPEC_CACHE[key] = cached
+                template, strings, cap = cached
+                specs = template.copy()  # concurrent encoders don't share
+                specs["data_ptr"] = [
+                    a.__array_interface__["data"][0] for a in arrs
+                ]
+                hdr = RolloutHeader(
+                    model_version, env_id, rollout_id, length, total_reward
+                )
+                spec_ptr = specs.ctypes.data_as(ctypes.POINTER(EncodeTensor))
+                out = np.empty(cap, np.uint8)
+                written = lib.dota_encode_rollout(
+                    ctypes.byref(hdr), strings, spec_ptr, n,
+                    out.ctypes.data, cap,
+                )
+                if written > cap:  # estimate too small: size back, retry once
+                    out = np.empty(written, np.uint8)
+                    written = lib.dota_encode_rollout(
+                        ctypes.byref(hdr), strings, spec_ptr, n,
+                        out.ctypes.data, written,
+                    )
+                del arrs  # pinned the numpy buffers across the C calls
+                if written >= 0:
+                    # bytes-like, not bytes: a second whole-payload memcpy
+                    # (`tobytes`) would halve the single-copy win; sockets,
+                    # ParseFromString, and len() all take the view directly
+                    return out[:written].data
+    return encode_rollout(
+        arrays, model_version, env_id, rollout_id, length, total_reward
+    ).SerializeToString()
 
 
 def encode_weights(params: Any, version: int) -> pb.ModelWeights:

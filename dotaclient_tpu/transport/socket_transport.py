@@ -41,8 +41,15 @@ _HEADER = struct.Struct(">BI")
 MAX_FRAME = 512 * 1024 * 1024
 
 
-def _send_frame(sock: socket.socket, kind: int, payload: bytes) -> None:
-    sock.sendall(_HEADER.pack(kind, len(payload)) + payload)
+def _send_frame(sock: socket.socket, kind: int, payload) -> None:
+    # gather write: no header+payload concat copy (payload may be a
+    # memoryview straight out of the native encoder)
+    header = _HEADER.pack(kind, len(payload))
+    sent = sock.sendmsg([header, payload])
+    total = len(header) + len(payload)
+    if sent < total:  # rare partial send: finish with sendall
+        rest = (header + bytes(payload))[sent:]
+        sock.sendall(rest)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -289,9 +296,13 @@ class SocketTransport:
             ) from self._dead
 
     def publish_rollout(self, rollout: pb.Rollout) -> None:
+        self.publish_rollout_bytes(rollout.SerializeToString())
+
+    def publish_rollout_bytes(self, payload) -> None:
+        """Ship pre-serialized wire bytes-like (the native-encoder path)."""
         self._check()
         with self._send_lock:
-            _send_frame(self._sock, _KIND_ROLLOUT, rollout.SerializeToString())
+            _send_frame(self._sock, _KIND_ROLLOUT, payload)
 
     def consume_rollouts(
         self, max_count: int, timeout: Optional[float] = None

@@ -8,6 +8,7 @@ from dotaclient_tpu.transport.serialize import (
     decode_rollout,
     decode_rollout_bytes,
     encode_rollout,
+    encode_rollout_bytes,
 )
 from dotaclient_tpu.protos import dota_pb2 as pb
 
@@ -88,3 +89,97 @@ class TestNativeCodec:
         m2, a2 = decode_rollout(r)
         assert m1 == m2
         np.testing.assert_array_equal(a1["rewards"], a2["rewards"])
+
+
+def sample_arrays_meta(seed=0):
+    rng = np.random.default_rng(seed)
+    arrays = {
+        "obs": {
+            "units": rng.normal(size=(17, 32, 22)).astype(np.float32),
+            "unit_mask": rng.random((17, 32)) > 0.5,
+            "hero_id": np.arange(17, dtype=np.int32),
+        },
+        "rewards": rng.normal(size=(16,)).astype(np.float32),
+        "scalar": np.float32(2.5),
+        "carry0": (
+            rng.normal(size=(128,)).astype(np.float32),
+            rng.normal(size=(128,)).astype(np.float32),
+        ),
+    }
+    meta = dict(model_version=7, env_id=3, rollout_id=123456789,
+                length=16, total_reward=-2.5)
+    return arrays, meta
+
+
+class TestNativeEncoder:
+    def test_protobuf_parses_native_bytes_identically(self, native_lib):
+        """python-protobuf must parse the C writer's output to the exact
+        message the protobuf encoder would have produced."""
+        arrays, meta = sample_arrays_meta()
+        payload = encode_rollout_bytes(arrays, native=True, **meta)
+        want = encode_rollout(arrays, **meta)
+        got = pb.Rollout()
+        got.ParseFromString(payload)
+        assert got.model_version == want.model_version
+        assert got.env_id == want.env_id
+        assert got.rollout_id == want.rollout_id
+        assert got.length == want.length
+        assert got.total_reward == pytest.approx(want.total_reward)
+        assert set(got.arrays) == set(want.arrays)
+        for name in want.arrays:
+            assert got.arrays[name] == want.arrays[name], name
+
+    def test_roundtrip_through_native_decoder(self, native_lib):
+        import jax
+
+        arrays, meta = sample_arrays_meta(seed=5)
+        payload = encode_rollout_bytes(arrays, native=True, **meta)
+        m, a = decode_rollout_bytes(payload, native=True)
+        assert m == {**meta, "total_reward": pytest.approx(-2.5)}
+        flat_in = {
+            k: np.asarray(v)
+            for k, v in jax.tree_util.tree_flatten_with_path(arrays)[0]
+        }
+        flat_out = {
+            k: np.asarray(v)
+            for k, v in jax.tree_util.tree_flatten_with_path(a)[0]
+        }
+        assert set(map(str, flat_in)) == set(map(str, flat_out))
+        for k, v in flat_in.items():
+            np.testing.assert_array_equal(v, flat_out[k])
+
+    def test_zero_header_and_empty_array(self, native_lib):
+        arrays = {"empty": np.zeros((0, 4), np.float32),
+                  "x": np.ones((3,), np.int32)}
+        meta = dict(model_version=0, env_id=0, rollout_id=0, length=0,
+                    total_reward=0.0)
+        payload = encode_rollout_bytes(arrays, native=True, **meta)
+        # zero-valued scalars are omitted on the wire (proto3), so the
+        # protobuf encoding must be byte-identical modulo map order; with
+        # sorted single-pass writes we just check the parse.
+        m, a = decode_rollout_bytes(payload, native=True)
+        assert m["model_version"] == 0 and m["total_reward"] == 0.0
+        assert a["empty"].shape == (0, 4)
+        np.testing.assert_array_equal(a["x"], np.ones((3,), np.int32))
+
+    def test_bfloat16_roundtrip(self, native_lib):
+        import ml_dtypes
+
+        arrays = {"x": np.arange(8).astype(ml_dtypes.bfloat16)}
+        payload = encode_rollout_bytes(
+            arrays, model_version=1, env_id=0, rollout_id=0, length=1,
+            total_reward=0.0, native=True,
+        )
+        _, a = decode_rollout_bytes(payload)
+        assert a["x"].dtype == ml_dtypes.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(a["x"], np.float32), np.arange(8, dtype=np.float32)
+        )
+
+    def test_fallback_matches_native(self, native_lib):
+        arrays, meta = sample_arrays_meta(seed=9)
+        nat = encode_rollout_bytes(arrays, native=True, **meta)
+        py = encode_rollout_bytes(arrays, native=False, **meta)
+        a = pb.Rollout(); a.ParseFromString(nat)
+        b = pb.Rollout(); b.ParseFromString(py)
+        assert a == b

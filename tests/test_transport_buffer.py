@@ -182,6 +182,26 @@ class TestTrajectoryBuffer:
         assert kept == 1
         assert buf.dropped_stale == 1
 
+    def test_config_skewed_rollout_dropped_not_fatal(self):
+        """A rollout with mismatched shapes (actor running a different
+        rollout_len or model config) must be dropped at the ingest door —
+        the disposable-actor failure model (SURVEY.md §5.3), not a learner
+        crash."""
+        buf, cfg = self.make()
+        good = self.decoded(0)
+        meta, row = self.decoded(1)
+        skewed = jax.tree.map(
+            lambda x: np.repeat(x, 2, axis=0) if x.ndim else x, row
+        )  # doubled leading (time) dims everywhere
+        wrong_struct = ({"model_version": 0, "env_id": 0, "rollout_id": 9,
+                         "length": 4, "total_reward": 0.0},
+                        {"not_a_batch": np.zeros((3,), np.float32)})
+        kept = buf.add([good, (meta, skewed), wrong_struct], current_version=0)
+        assert kept == 1
+        assert buf.dropped_skew == 2
+        assert buf.size == 1
+        assert buf.metrics()["buffer_dropped_skew"] == 2.0
+
     def test_ring_wraparound_overwrites_oldest(self):
         buf, cfg = self.make(capacity=16, batch_rollouts=8)
         buf.add([self.decoded(i) for i in range(16)], 0)
