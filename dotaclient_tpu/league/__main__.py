@@ -1,0 +1,91 @@
+"""Standalone win-rate evaluation from a checkpoint.
+
+BASELINE.json's second headline metric is win-rate vs the hard scripted bot;
+the reference's de-facto eval was watching TensorBoard curves during live
+games (SURVEY.md §4). Here it is one command against a saved run:
+
+    python -m dotaclient_tpu.league --checkpoint runs/ckpt
+    python -m dotaclient_tpu.league --checkpoint runs/ckpt \
+        --opponent scripted_easy --games 128
+    python -m dotaclient_tpu.league --checkpoint runs/A --vs runs/B
+
+``--vs`` plays checkpoint-vs-checkpoint (league mode): A controls the
+learner side, B is the frozen opponent. Each checkpoint's own stored config
+governs its model tree; the first checkpoint's env config (team size, hero
+pool) hosts the match. Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _load(directory: str):
+    from dotaclient_tpu.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(directory)
+    config = mgr.restore_config()
+    state, config = mgr.restore(config)
+    mgr.close()
+    return config, state
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--checkpoint", type=str, required=True,
+                   help="checkpoint directory (orbax run dir)")
+    p.add_argument("--vs", type=str, default=None,
+                   help="second checkpoint directory: play league mode "
+                        "against its (frozen) policy instead of a bot")
+    p.add_argument("--opponent", type=str, default="scripted_hard",
+                   help="scripted opponent mode when --vs is absent")
+    p.add_argument("--games", type=int, default=64)
+    p.add_argument("--seed", type=int, default=7)
+    args = p.parse_args(argv)
+
+    from dotaclient_tpu.league import evaluate
+    from dotaclient_tpu.models import make_policy
+
+    config, state = _load(args.checkpoint)
+    policy = make_policy(config.model, config.obs, config.actions)
+
+    if args.vs is not None:
+        opp_config, opp_state = _load(args.vs)
+        if (config.model, config.obs, config.actions) != (
+            opp_config.model, opp_config.obs, opp_config.actions
+        ):
+            print(
+                "league eval: --vs checkpoint has a different model/obs "
+                "config; both sides must share one policy architecture "
+                "(the sim hosts one observation/action space per match)",
+                file=sys.stderr, flush=True,
+            )
+            return 2
+        result = evaluate(
+            config, policy, state.params, "league",
+            opponent_params=opp_state.params,
+            n_games=args.games, seed=args.seed,
+        )
+        opponent = f"checkpoint:{args.vs}@step{int(opp_state.step)}"
+    else:
+        result = evaluate(
+            config, policy, state.params, args.opponent,
+            n_games=args.games, seed=args.seed,
+        )
+        opponent = args.opponent
+
+    print(json.dumps({
+        "checkpoint": args.checkpoint,
+        "step": int(state.step),
+        "opponent": opponent,
+        "games": int(result["episodes"]),
+        "win_rate": round(float(result["win_rate"]), 4),
+        "episode_reward_mean": round(float(result["episode_reward_mean"]), 4),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

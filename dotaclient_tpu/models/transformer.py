@@ -29,6 +29,39 @@ def _dtype(name: str):
     return {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[name]
 
 
+class _GRUGate(nn.Module):
+    """GTrXL gated residual (Parisotto et al. 2019): replaces ``x + y`` with
+    a GRU-style gate whose bias initializes the gate nearly closed, so each
+    block starts as (close to) the identity on the stream. This is the
+    standard stabilizer for transformers under RL gradients — the plain
+    residual form measurably collapses mid-training on the lane sim
+    (reward +6 → −1 at ~13k optimizer steps, BASELINE.md), exactly the
+    failure mode the gating was designed for.
+    """
+
+    config: ModelConfig
+    bias_init: float = 2.0
+
+    @nn.compact
+    def __call__(self, x, y):
+        cfg = self.config
+        dtype, pdtype = _dtype(cfg.dtype), _dtype(cfg.param_dtype)
+        H = cfg.hidden_dim
+
+        def dense(name):
+            return nn.Dense(
+                H, use_bias=False, dtype=dtype, param_dtype=pdtype, name=name
+            )
+
+        r = nn.sigmoid(dense("wr")(y) + dense("ur")(x))
+        bg = self.param(
+            "bg", nn.initializers.constant(self.bias_init), (H,), pdtype
+        )
+        z = nn.sigmoid(dense("wz")(y) + dense("uz")(x) - bg.astype(dtype))
+        h_hat = nn.tanh(dense("wg")(y) + dense("ug")(r * x))
+        return (1.0 - z) * x + z * h_hat
+
+
 class _Block(nn.Module):
     """Pre-LN attention block operating on one timestep + its KV window."""
 
@@ -59,20 +92,31 @@ class _Block(nn.Module):
         vh = vals.reshape(B, W + 1, nh, dh)
         logits = jnp.einsum("bhd,bkhd->bhk", qh, kh).astype(jnp.float32)
         logits = logits / jnp.sqrt(jnp.asarray(dh, jnp.float32))
+        # Learned relative-position bias per head: window slot k has a fixed
+        # age (W-k steps back), so one [nh, W+1] table IS the full relative
+        # encoding — without it the window is an unordered bag and the core
+        # cannot tell last step from W steps ago. Zero-init: parity with the
+        # bias-free form at initialization.
+        pos_bias = self.param(
+            "pos_bias", nn.initializers.zeros, (nh, W + 1), pdtype
+        )
+        logits = logits + pos_bias[None].astype(jnp.float32)
         logits = jnp.where(mask[:, None, :] > 0, logits, -1e9)
         w = nn.softmax(logits, axis=-1).astype(dtype)
         out = jnp.einsum("bhk,bkhd->bhd", w, vh).reshape(B, H)
-        h = h + nn.Dense(H, dtype=dtype, param_dtype=pdtype, name="o")(out)
+        attn = nn.Dense(H, dtype=dtype, param_dtype=pdtype, name="o")(out)
+        h = _GRUGate(cfg, name="gate_attn")(h, attn)
 
         hm = nn.LayerNorm(dtype=dtype, param_dtype=pdtype)(h)
         if cfg.moe_experts > 0:
             # routed-FFN option: per-token top-1 expert, expert weights
             # sharded over the `model` mesh axis (models/moe.py)
-            h = h + MoEMLP(cfg, name="moe")(hm)
+            ffn = MoEMLP(cfg, name="moe")(hm)
         else:
             hm = nn.Dense(4 * H, dtype=dtype, param_dtype=pdtype)(hm)
             hm = nn.gelu(hm)
-            h = h + nn.Dense(H, dtype=dtype, param_dtype=pdtype)(hm)
+            ffn = nn.Dense(H, dtype=dtype, param_dtype=pdtype)(hm)
+        h = _GRUGate(cfg, name="gate_ffn")(h, ffn)
 
         # roll the window: drop oldest, append this step (f32 cache — the
         # carry crosses the wire/buffer in f32 like the LSTM state)
@@ -98,6 +142,15 @@ class WindowedTransformerCore(nn.Module):
         for l in range(cfg.n_layers):
             new_kv, h = _Block(cfg, name=f"block_{l}")(caches[l], valid, h)
             new_caches.append(new_kv)
+        # Final pre-head LayerNorm: the pre-LN residual stream is unbounded
+        # (norms grow with depth/training), and the action/value heads
+        # consume this output directly — without normalization the head
+        # logit scale drifts, collapsing policy entropy early (the LSTM
+        # core's tanh output is bounded by construction).
+        h = nn.LayerNorm(
+            dtype=_dtype(cfg.dtype), param_dtype=_dtype(cfg.param_dtype),
+            name="out_ln",
+        )(h)
         B = valid.shape[0]
         new_valid = jnp.concatenate(
             [valid[:, 1:], jnp.ones((B, 1), valid.dtype)], axis=1

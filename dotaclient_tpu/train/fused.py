@@ -20,8 +20,11 @@ Trade-offs vs the buffered path (why both exist):
     staleness/version machinery has nothing to do;
   * the train batch IS the lane set (``n_lanes`` rollouts of length T) —
     ``ppo.batch_rollouts`` does not apply;
-  * ``epochs_per_batch`` > 1 is unsupported (the chunk lives only inside
-    the program);
+  * ``epochs_per_batch`` > 1 runs as a ``lax.scan`` of update steps over
+    the same chunk INSIDE the program (epoch 2+ are the standard PPO
+    re-uses, ratio clipped against the rollout's behavior_logp);
+    ``minibatches`` > 1 is unsupported (the chunk lives only inside the
+    program, so there is no host-side shuffle point);
   * no cross-process experience — single-host self-play only.
 
 The learner exposes it as ``actor="fused"``.
@@ -55,6 +58,8 @@ def make_fused_step(policy: Policy, config: RunConfig, mesh, actor):
     repl = replicated(mesh)
     st_sh = train_state_sharding(policy, config, mesh)
 
+    n_epochs = config.ppo.epochs_per_batch
+
     def fused(state, actor_state, opp_params):
         actor_state, chunk, stats = actor._rollout_impl(
             state.params, actor_state, opp_params
@@ -62,7 +67,18 @@ def make_fused_step(policy: Policy, config: RunConfig, mesh, actor):
         chunk = jax.tree.map(
             lambda x: jax.lax.with_sharding_constraint(x, ds), chunk
         )
-        new_state, metrics = _train_step(policy, config.ppo, state, chunk)
+        if n_epochs == 1:
+            new_state, metrics = _train_step(policy, config.ppo, state, chunk)
+        else:
+            def epoch(st, _):
+                return _train_step(policy, config.ppo, st, chunk)
+
+            new_state, metric_seq = jax.lax.scan(
+                epoch, state, None, length=n_epochs
+            )
+            # report the final epoch (the state reflects it), like the
+            # buffered loop's last logged step of a multi-epoch pass
+            metrics = jax.tree.map(lambda m: m[-1], metric_seq)
         return new_state, actor_state, metrics, stats
 
     # No donation: in self-play the caller passes state.params AS

@@ -83,12 +83,12 @@ class Learner:
         mode = actor or ("vec" if vec else "scalar")
         if mode not in ("device", "fused", "vec", "scalar", "external"):
             raise ValueError(f"unknown actor mode {mode!r}")
-        if mode == "fused" and (
-            config.ppo.epochs_per_batch != 1 or config.ppo.minibatches != 1
-        ):
+        if mode == "fused" and config.ppo.minibatches != 1:
             raise ValueError(
-                "fused mode trains each chunk exactly once inside the "
-                "program; epochs_per_batch and minibatches must be 1"
+                "fused mode consumes each chunk inside its one program — "
+                "there is no host shuffle point, so minibatches must be 1 "
+                "(epochs_per_batch > 1 is supported: the update scans over "
+                "the chunk in-program)"
             )
         if (
             config.ppo.minibatches > 1
@@ -501,11 +501,13 @@ class Learner:
                     self.state, da.state, opp_params
                 )
                 self._report_league(opp_idx, chunk_stats)
-                self._host_step += 1
-                self._host_version += 1
+                # the program ran `epochs` optimizer steps over this chunk —
+                # keep the host mirrors in lockstep with the device counters
+                self._host_step += epochs
+                self._host_version += epochs
                 da.env_steps += frames_per
                 da.rollouts_shipped += da.n_lanes
-                steps_done += 1
+                steps_done += epochs
                 after_step(m, frames=frames_per)
         elif self.device_actor is not None:
             # On-device rollout mode: collect→ingest→train is all dispatch

@@ -253,7 +253,14 @@ def encode_rollout_bytes(
 
         lib = load_library()
         if lib is not None and hasattr(lib, "dota_encode_rollout"):
-            assert _ENC_DTYPE.itemsize == ctypes.sizeof(EncodeTensor)
+            if _ENC_DTYPE.itemsize != ctypes.sizeof(EncodeTensor):
+                # load-bearing ABI check (a bare assert would vanish under
+                # python -O and let the C writer read garbage offsets)
+                raise ValueError(
+                    f"EncodeTensor ABI mismatch: numpy spec row is "
+                    f"{_ENC_DTYPE.itemsize} bytes, C struct is "
+                    f"{ctypes.sizeof(EncodeTensor)}"
+                )
             flat = flatten_tree(arrays)
             if all(a.ndim <= 8 for a in flat.values()):
                 n = len(flat)

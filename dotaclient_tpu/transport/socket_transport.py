@@ -46,10 +46,12 @@ def _send_frame(sock: socket.socket, kind: int, payload) -> None:
     # memoryview straight out of the native encoder)
     header = _HEADER.pack(kind, len(payload))
     sent = sock.sendmsg([header, payload])
-    total = len(header) + len(payload)
-    if sent < total:  # rare partial send: finish with sendall
-        rest = (header + bytes(payload))[sent:]
-        sock.sendall(rest)
+    if sent < len(header) + len(payload):  # rare partial send: finish it
+        if sent < len(header):
+            sock.sendall(header[sent:])
+            sent = len(header)
+        # memoryview slice — no whole-payload copy just to send the tail
+        sock.sendall(memoryview(payload)[sent - len(header):])
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:

@@ -9,6 +9,7 @@ optimizer state, step/version counters, and the config that produced them.
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 from typing import Any, Optional, Tuple
 
@@ -100,6 +101,28 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def _latest_step_or_raise(self) -> int:
+        step = self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        return step
+
+    @staticmethod
+    def _decode_config(raw: Any) -> RunConfig:
+        return RunConfig.from_json(json.dumps(raw))
+
+    def restore_config(self) -> RunConfig:
+        """Restore only the RunConfig of the latest checkpoint — the
+        bootstrap for tools that must build the model tree BEFORE they can
+        restore weights (the checkpoint's own config is authoritative for
+        its parameter shapes; guessing a config risks a template mismatch).
+        """
+        restored = self._mgr.restore(
+            self._latest_step_or_raise(),
+            args=ocp.args.Composite(config=ocp.args.JsonRestore()),
+        )
+        return self._decode_config(restored["config"])
+
     def restore(
         self, config: RunConfig, abstract_state: Optional[TrainState] = None
     ) -> Tuple[TrainState, RunConfig]:
@@ -108,9 +131,7 @@ class CheckpointManager:
         ``abstract_state`` provides the target pytree structure; built from
         ``config`` when omitted.
         """
-        step = self._mgr.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        step = self._latest_step_or_raise()
         if abstract_state is None:
             from dotaclient_tpu.models import init_params, make_policy
 
@@ -137,7 +158,7 @@ class CheckpointManager:
             params=jax.tree.map(jax.numpy.asarray, raw["params"]),
             opt_state=jax.tree.map(jax.numpy.asarray, raw["opt_state"]),
         )
-        cfg = RunConfig.from_json(__import__("json").dumps(restored["config"]))
+        cfg = self._decode_config(restored["config"])
         return state, cfg
 
     def close(self) -> None:

@@ -39,6 +39,12 @@ def main() -> None:
                    choices=("fused", "device"),
                    help="fused: one program per optimizer step (fastest); "
                    "device: buffered loop (round-2 demo parity)")
+    p.add_argument("--core", type=str, default="lstm",
+                   choices=("lstm", "transformer"),
+                   help="policy core; transformer = windowed-attention core "
+                   "(rolling KV-cache carry), the scale-out option")
+    p.add_argument("--moe-experts", type=int, default=0,
+                   help="with --core transformer: experts per MoE FFN layer")
     args = p.parse_args()
 
     from dotaclient_tpu.config import default_config
@@ -48,6 +54,9 @@ def main() -> None:
     config = default_config()
     config = dataclasses.replace(
         config,
+        model=dataclasses.replace(
+            config.model, core=args.core, moe_experts=args.moe_experts
+        ),
         env=dataclasses.replace(
             config.env, n_envs=args.n_envs, opponent="scripted_easy",
             max_dota_time=300.0,

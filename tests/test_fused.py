@@ -89,14 +89,33 @@ class TestFusedStep:
         # frames accounting reflects the lane-set batch, not batch_rollouts
         assert out["frames_trained"] == 4 * learner.device_actor.n_lanes * 4
 
-    def test_fused_rejects_multi_epoch(self):
+    def test_fused_multi_epoch_scans_updates_in_program(self):
+        """epochs_per_batch > 1 in fused mode: the one program applies E
+        optimizer steps over its chunk (lax.scan), and the host counters
+        stay in lockstep with the device step/version counters."""
         from dotaclient_tpu.train.learner import Learner
 
         cfg = tiny_cfg()
         cfg = dataclasses.replace(
             cfg, ppo=dataclasses.replace(cfg.ppo, epochs_per_batch=2)
         )
-        with pytest.raises(ValueError, match="epochs_per_batch"):
+        learner = Learner(cfg, actor="fused", seed=1)
+        out = learner.train(4)    # 2 fused calls × 2 epochs
+        assert out["optimizer_steps"] == 4.0
+        assert np.isfinite(out["loss"])
+        assert int(learner.state.step) == 4
+        assert int(learner.state.version) == learner._host_version
+        # each fused call contributes ONE chunk of unique frames
+        assert out["frames_trained"] == 2 * learner.device_actor.n_lanes * 4
+
+    def test_fused_rejects_minibatches(self):
+        from dotaclient_tpu.train.learner import Learner
+
+        cfg = tiny_cfg()
+        cfg = dataclasses.replace(
+            cfg, ppo=dataclasses.replace(cfg.ppo, minibatches=2)
+        )
+        with pytest.raises(ValueError, match="minibatches"):
             Learner(cfg, actor="fused")
 
     def test_fused_league_uses_frozen_opponent(self):

@@ -151,6 +151,36 @@ class TestEvaluate:
         assert out["episodes"] >= 4
 
 
+class TestEvalCli:
+    def test_eval_from_checkpoint_and_vs_checkpoint(self, tmp_path, capsys):
+        """`python -m dotaclient_tpu.league`: restore a run's checkpoint by
+        its OWN stored config and play eval games — the reference's
+        watch-TensorBoard eval as one command (SURVEY.md §4)."""
+        import json
+
+        from dotaclient_tpu.league.__main__ import main
+        from dotaclient_tpu.train.learner import Learner
+
+        cfg = small_config(opponent="scripted_easy")
+        ckpt = str(tmp_path / "run_a")
+        learner = Learner(cfg, actor="device", seed=3, checkpoint_dir=ckpt)
+        learner.train(2)   # end-of-run save included
+
+        rc = main(["--checkpoint", ckpt, "--opponent", "scripted_easy",
+                   "--games", "2", "--seed", "1"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["opponent"] == "scripted_easy"
+        assert out["games"] >= 2
+        assert 0.0 <= out["win_rate"] <= 1.0
+
+        rc = main(["--checkpoint", ckpt, "--vs", ckpt, "--games", "2",
+                   "--seed", "1"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["opponent"].startswith("checkpoint:")
+
+
 class TestLearnerLeagueWiring:
     def test_device_league_trains_and_snapshots(self):
         from dotaclient_tpu.train.learner import Learner
