@@ -233,6 +233,13 @@ class Policy(nn.Module):
 
 
 def make_policy(model: ModelConfig, obs_spec: ObsSpec, action_spec: ActionSpec) -> Policy:
+    if model.moe_experts > 0 and model.core != "transformer":
+        # only the transformer core routes an MoE FFN; silently training a
+        # dense LSTM under an "8-expert" label would mislabel every result
+        raise ValueError(
+            f"moe_experts={model.moe_experts} requires core='transformer' "
+            f"(got core={model.core!r}); the LSTM core has no FFN to route"
+        )
     return Policy(model=model, obs_spec=obs_spec, action_spec=action_spec)
 
 

@@ -640,6 +640,14 @@ def main(argv=None) -> Dict[str, float]:
     p.add_argument("--opponent", type=str, default=None)
     p.add_argument("--team-size", type=int, default=None)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--core", type=str, default=None,
+                   choices=("lstm", "transformer"),
+                   help="policy core: nn.scan LSTM(128) (reference parity, "
+                   "default) or the GTrXL-gated windowed-attention "
+                   "transformer (scale-out option)")
+    p.add_argument("--moe-experts", type=int, default=None,
+                   help="with --core transformer: experts per MoE FFN "
+                   "layer (0 = dense FFN)")
     p.add_argument(
         "--overlap", action="store_true",
         help="run the actor pool in a background thread (async actor-learner)",
@@ -714,6 +722,15 @@ def main(argv=None) -> Dict[str, float]:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     config = default_config()
+    model_over = {}
+    if args.core is not None:
+        model_over["core"] = args.core
+    if args.moe_experts is not None:
+        model_over["moe_experts"] = args.moe_experts
+    if model_over:
+        config = dataclasses.replace(
+            config, model=dataclasses.replace(config.model, **model_over)
+        )
     mesh_over = {}
     if args.dcn_slices is not None:
         mesh_over["dcn_slices"] = args.dcn_slices

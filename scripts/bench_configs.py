@@ -91,12 +91,19 @@ def main() -> None:
                    choices=("device", "fused"),
                    help="device: buffered loop; fused: one program per step "
                    "(batch = lane set, so frames/step scales with lanes)")
+    p.add_argument("--core", type=str, default="lstm",
+                   choices=("lstm", "transformer"),
+                   help="policy core used across all configs")
     args = p.parse_args()
 
     from dotaclient_tpu.config import default_config
     from dotaclient_tpu.train.learner import Learner
 
     base = default_config()
+    if args.core != "lstm":
+        base = dataclasses.replace(
+            base, model=dataclasses.replace(base.model, core=args.core)
+        )
     B, T = base.ppo.batch_rollouts, base.ppo.rollout_len
     results = []
     for n in (int(s) for s in args.configs.split(",")):
@@ -117,6 +124,7 @@ def main() -> None:
             "config": n,
             "desc": desc,
             "mode": args.mode,
+            "core": args.core,
             "end_to_end_frames_per_sec": round(fps, 1),
             "n_envs": cfg.env.n_envs,
             "team_size": cfg.env.team_size,
