@@ -109,6 +109,21 @@ class DeviceActor:
             N, config.env.team_size, config.env.hero_pool,
             config.env.opponent, seed,
         )
+        # League anchor games (LeagueConfig.anchor_prob): the first K games
+        # pin the opponent side to a scripted bot — the sim's control-mode
+        # override wins over the snapshot policy's actions there. Keeps
+        # fight/push behavior in an otherwise pure self-play meta.
+        self.n_anchor_games = 0
+        if config.env.opponent == "league" and config.league.anchor_prob > 0:
+            from dotaclient_tpu.envs.vec_lane_sim import OPPONENT_CONTROL
+
+            self.n_anchor_games = int(round(config.league.anchor_prob * N))
+            control[: self.n_anchor_games, config.env.team_size:] = (
+                OPPONENT_CONTROL[config.league.anchor_opponent]
+            )
+        # per-game mask of NON-anchor games: PFSP attribution must not
+        # credit/blame a snapshot for games a scripted bot actually played
+        self._league_game_mask = jnp.arange(N) >= self.n_anchor_games
 
         key = jax.random.PRNGKey(seed)
         key, k_init = jax.random.split(key)
@@ -137,7 +152,10 @@ class DeviceActor:
     @staticmethod
     def _zero_stats() -> Dict[str, jnp.ndarray]:
         z = jnp.zeros((), jnp.float32)
-        return {"episodes": z, "wins": z, "reward_sum": z, "ep_return_sum": z}
+        return {
+            "episodes": z, "wins": z, "reward_sum": z, "ep_return_sum": z,
+            "league_episodes": z, "league_wins": z,
+        }
 
     # -- the jitted chunk generator ---------------------------------------
 
@@ -198,10 +216,15 @@ class DeviceActor:
 
             sim2 = sim_mod.step(
                 spec, sim, sim_acts,
-                scripted_possible=self.config.env.opponent
-                not in ("selfplay", "league"),
+                scripted_possible=(
+                    self.config.env.opponent not in ("selfplay", "league")
+                    or self.n_anchor_games > 0
+                ),
             )
-            r = shaped_rewards(spec, self.learner_players, sim, sim2)
+            r = shaped_rewards(
+                spec, self.learner_players, sim, sim2,
+                weights=cfg.reward.as_dict(),
+            )
             done_g = sim2.done
             win_g = done_g & (sim2.winning_team == owner_team)
             ep_ret = ep_ret + r
@@ -260,11 +283,15 @@ class DeviceActor:
             "valid": jnp.ones((self.n_lanes, T), jnp.float32),
             "carry0": carry0,
         }
+        lg = self._league_game_mask[None, :]     # [1, N] non-anchor games
         stats = {
             "episodes": outs["ep_done"].sum().astype(jnp.float32),
             "wins": outs["win"].sum().astype(jnp.float32),
             "reward_sum": outs["reward"].sum(),
             "ep_return_sum": outs["ep_return"].sum(),
+            # snapshot-attributable outcomes only (anchor games excluded)
+            "league_episodes": (outs["ep_done"] & lg).sum().astype(jnp.float32),
+            "league_wins": (outs["win"] & lg).sum().astype(jnp.float32),
         }
         cum_stats = {k: state.stats[k] + stats[k] for k in stats}
         new_state = DeviceActorState(

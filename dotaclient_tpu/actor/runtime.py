@@ -142,6 +142,8 @@ class ActorPool:
             )
         self.config = config
         self.policy = policy
+        self._reward_weights = dict(config.reward.as_dict())
+        self._warn_no_anchor_support()
         # (params, version) swap atomically as one tuple: the learner thread
         # may refresh weights while the actor thread is mid-step, and a chunk
         # must never be tagged with a version newer than the params that
@@ -179,6 +181,18 @@ class ActorPool:
         self.wins = 0
 
     # -- env / lane lifecycle ---------------------------------------------
+
+    def _warn_no_anchor_support(self) -> None:
+        # same visibility discipline as the host-pool PFSP warning: a knob
+        # this pool cannot honor must say so, not silently no-op
+        cfg = self.config
+        if cfg.env.opponent == "league" and cfg.league.anchor_prob > 0:
+            print(
+                "WARNING: league.anchor_prob is implemented by the "
+                "device/fused actors only; this host pool runs pure "
+                "snapshot self-play (no scripted-anchor games)",
+                flush=True,
+            )
 
     def _reset_env(self, env_idx: int, env: LocalDotaEnv) -> None:
         game_cfg = build_game_config(self.config, self._next_game_seed)
@@ -324,7 +338,10 @@ class ActorPool:
             env = self.envs[lane.env_idx]
             resp = env.observe(lane.team_id)
             ws = resp.world_state
-            r, _ = shaped_reward(lane.prev_ws, ws, lane.player_id)
+            r, _ = shaped_reward(
+                lane.prev_ws, ws, lane.player_id,
+                weights=self._reward_weights,
+            )
             done = env.done
             lane.rewards.append(r)
             lane.dones.append(1.0 if done else 0.0)

@@ -114,7 +114,17 @@ class VecActorPool:
             learner_players = list(range(env.team_size))
             opponent_players = []
         self.feat = VecFeaturizer(self.sim, config.obs, config.actions, learner_players)
-        self.rewards = VecRewards(self.sim, learner_players)
+        self.rewards = VecRewards(
+            self.sim, learner_players, weights=dict(config.reward.as_dict())
+        )
+        if config.env.opponent == "league" and config.league.anchor_prob > 0:
+            # a knob this pool cannot honor must say so, not silently no-op
+            print(
+                "WARNING: league.anchor_prob is implemented by the "
+                "device/fused actors only; this host pool runs pure "
+                "snapshot self-play (no scripted-anchor games)",
+                flush=True,
+            )
         self._opponent: Optional["_OpponentLanes"] = None
         if opponent_players:
             self._opponent = _OpponentLanes(

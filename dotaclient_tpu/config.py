@@ -100,6 +100,34 @@ class EnvConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class RewardConfig:
+    """Shaped-reward component weights.
+
+    The reference hardcoded its shaping inside ``agent.py`` (SURVEY.md §2.1);
+    here the table is part of the config tree (checkpointed, overridable per
+    run). Defaults reproduce ``features/reward.py``'s historical weights.
+    The 5v5 pure-self-play experiments in BASELINE.md show why this must be
+    tunable: dense farm shaping can dominate the sparse win/tower terms and
+    converge to a farming equilibrium that loses the timeout adjudication.
+    """
+
+    xp: float = 0.002
+    gold: float = 0.006
+    hp: float = 2.0            # own-hero hp *fraction* delta
+    enemy_hp: float = 1.0      # symmetric harass term
+    last_hits: float = 0.16
+    denies: float = 0.12
+    kills: float = 1.0
+    deaths: float = -1.0
+    tower_damage: float = 2.0  # enemy tower hp-fraction lost
+    own_tower: float = 2.0     # OWN tower hp-fraction lost (defense term)
+    win: float = 5.0
+
+    def as_dict(self) -> Mapping[str, float]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Device mesh layout. Axes: dcn (multi-slice), data (batch/grad psum),
     model (TP). With ``dcn_slices == 1`` the mesh is 2-D (data, model)."""
@@ -133,6 +161,14 @@ class LeagueConfig:
     # ONE opponent — the per-chunk outcome attribution PFSP feeds on stays
     # meaningful, and lanes stop seeing mid-episode opponent swaps.
     opponent_hold: int = 64
+    # Scripted-anchor games (AlphaStar-style league exploiters, simplified):
+    # this fraction of the device actor's games pins the opponent side to a
+    # scripted bot instead of a pool snapshot. Pure self-play pools can
+    # converge to metas where nobody pressures towers (BASELINE.md "5v5
+    # farming equilibrium"); anchors keep fight/push behavior in the
+    # training distribution. Anchor outcomes are excluded from PFSP stats.
+    anchor_prob: float = 0.0
+    anchor_opponent: str = "scripted_hard"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -144,6 +180,7 @@ class RunConfig:
     model: ModelConfig = ModelConfig()
     ppo: PPOConfig = PPOConfig()
     env: EnvConfig = EnvConfig()
+    reward: RewardConfig = RewardConfig()
     mesh: MeshConfig = MeshConfig()
     buffer: BufferConfig = BufferConfig()
     league: LeagueConfig = LeagueConfig()
@@ -167,6 +204,8 @@ class RunConfig:
             model=ModelConfig(**raw["model"]),
             ppo=PPOConfig(**raw["ppo"]),
             env=EnvConfig(**{**raw["env"], "hero_pool": tuple(raw["env"]["hero_pool"])}),
+            # absent in checkpoints written before RewardConfig existed
+            reward=RewardConfig(**raw.get("reward", {})),
             mesh=MeshConfig(**raw["mesh"]),
             buffer=BufferConfig(**raw["buffer"]),
             league=LeagueConfig(**raw["league"]),

@@ -19,7 +19,7 @@ from dotaclient_tpu.envs.jax_lane_sim import SimState, hero_castable
 from dotaclient_tpu.envs.lane_sim import NUKE_RANGE, TEAM_RADIANT
 from dotaclient_tpu.envs.vec_lane_sim import VecSimSpec
 from dotaclient_tpu.features import featurizer as F
-from dotaclient_tpu.features.reward import WEIGHTS
+from dotaclient_tpu.features.reward import WEIGHTS as _DEFAULT_WEIGHTS
 from dotaclient_tpu.protos import dota_pb2 as pb
 
 
@@ -233,9 +233,13 @@ def shaped_rewards(
     agent_players: Sequence[int],
     prev: SimState,
     cur: SimState,
+    weights=None,
 ) -> jnp.ndarray:
     """Per-lane shaped reward [L] for the prev→cur interval (jnp port of
-    ``VecRewards``; same WEIGHTS and components as ``features.reward``)."""
+    ``VecRewards``; same components as ``features.reward``; ``weights``
+    overrides the default table — Python floats, so they are compile-time
+    constants)."""
+    WEIGHTS = _DEFAULT_WEIGHTS if weights is None else weights
     P = spec.n_players
     ap = jnp.asarray(tuple(int(p) for p in agent_players), jnp.int32)
 

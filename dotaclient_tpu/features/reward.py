@@ -14,26 +14,14 @@ from typing import Dict, Optional, Tuple
 
 from dotaclient_tpu.protos import dota_pb2 as pb
 
-# Per-component weights. Magnitudes follow the shaping the reference family
-# used: dense micro-rewards for farm/harass, sparse large terms for kills,
-# towers and the win.
-WEIGHTS: Dict[str, float] = {
-    "xp": 0.002,
-    "gold": 0.006,
-    "hp": 2.0,            # applied to hp *fraction* delta
-    "enemy_hp": 1.0,      # symmetric harass term (negative of enemy's hp term)
-    "last_hits": 0.16,
-    "denies": 0.12,
-    "kills": 1.0,
-    "deaths": -1.0,
-    "tower_damage": 2.0,  # enemy tower hp-fraction lost
-    "own_tower": 2.0,     # OWN tower hp-fraction lost (defense term):
-                          # without it, self-play converges to farming
-                          # with nobody defending, and the timeout
-                          # adjudication (own-tower hp first) is lost
-                          # to any opponent that incidentally defends
-    "win": 5.0,
-}
+# Per-component weights: the defaults of config.RewardConfig (the single
+# source of truth — per-run overrides come through the config tree).
+# Magnitudes follow the shaping the reference family used: dense
+# micro-rewards for farm/harass, sparse large terms for kills, towers and
+# the win.
+from dotaclient_tpu.config import RewardConfig
+
+WEIGHTS: Dict[str, float] = dict(RewardConfig().as_dict())
 
 
 def _player(ws: pb.WorldState, player_id: int) -> Optional[pb.Player]:
@@ -104,9 +92,13 @@ def reward_components(
 
 
 def shaped_reward(
-    prev: pb.WorldState, cur: pb.WorldState, player_id: int
+    prev: pb.WorldState,
+    cur: pb.WorldState,
+    player_id: int,
+    weights: Optional[Dict[str, float]] = None,
 ) -> Tuple[float, Dict[str, float]]:
     """Scalar shaped reward plus the weighted per-component breakdown."""
+    w = WEIGHTS if weights is None else weights
     comps = reward_components(prev, cur, player_id)
-    weighted = {k: WEIGHTS[k] * v for k, v in comps.items()}
+    weighted = {k: w[k] * v for k, v in comps.items()}
     return sum(weighted.values()), weighted

@@ -18,7 +18,7 @@ in ``tests/test_vec_sim.py`` by featurizing the same game state both ways.
 
 from __future__ import annotations
 
-from typing import Dict, Sequence
+from typing import Dict, Optional, Sequence
 
 import numpy as np
 
@@ -245,9 +245,15 @@ class VecRewards:
     """Shaped reward for every lane from sim-state deltas — the vector form
     of ``features.reward.shaped_reward`` (same WEIGHTS, same components)."""
 
-    def __init__(self, sim: VecLaneSim, agent_players: Sequence[int]) -> None:
+    def __init__(
+        self,
+        sim: VecLaneSim,
+        agent_players: Sequence[int],
+        weights: Optional[Dict[str, float]] = None,
+    ) -> None:
         self.sim = sim
         self.agent_players = np.asarray(agent_players, np.int64)
+        self.weights = dict(WEIGHTS if weights is None else weights)
         self.snapshot()
 
     def _state(self) -> Dict[str, np.ndarray]:
@@ -320,6 +326,7 @@ class VecRewards:
         own_tower_prev = np.where(i_rad, prev["tower"][:, 0:1], prev["tower"][:, 1:2])
         own_tower_cur = np.where(i_rad, cur["tower"][:, 0:1], cur["tower"][:, 1:2])
 
+        WEIGHTS = self.weights
         r = (
             WEIGHTS["xp"] * (cur["xp"] - prev["xp"])
             + WEIGHTS["gold"] * (cur["gold"] - prev["gold"])

@@ -42,6 +42,10 @@ def evaluate(
     eval_cfg = dataclasses.replace(
         config,
         env=dataclasses.replace(config.env, n_envs=n_games, opponent=opponent),
+        # an eval measures ONE opponent: anchor games (a training-time
+        # distribution lever) would silently swap a fraction of the games
+        # to the scripted bot and contaminate the reported win_rate
+        league=dataclasses.replace(config.league, anchor_prob=0.0),
     )
     actor = DeviceActor(eval_cfg, policy, seed=seed)
     steps_per_episode = eval_cfg.env.max_dota_time / (

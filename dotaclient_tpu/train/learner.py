@@ -412,8 +412,12 @@ class Learner:
         pending, self._league_pending = self._league_pending, []
         fetched = jax.device_get([st for _, st in pending])  # one sync
         for (idx, _), st in zip(pending, fetched):
+            # anchor games (scripted-bot opponents) are excluded from the
+            # snapshot's PFSP record — it never played them
             self.league.report(
-                idx, float(st["wins"]), float(st["episodes"])
+                idx,
+                float(st.get("league_wins", st["wins"])),
+                float(st.get("league_episodes", st["episodes"])),
             )
 
     def _refresh_league_opponent(self) -> None:

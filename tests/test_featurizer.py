@@ -194,6 +194,31 @@ class TestReward:
         assert comps["gold"] > 0
         assert r > 0
 
+    def test_configurable_weights_override_default_table(self):
+        """RewardConfig weights flow into the shaping (the table is config,
+        not a constant — per-run shaping experiments without code edits)."""
+        import dataclasses
+
+        from dotaclient_tpu.config import RewardConfig
+
+        sim = make_sim()
+        prev = sim.world_state(TEAM_RADIANT)
+        hero = sim.hero_for_player(0)
+        hero.last_hits += 1
+        hero.gold += 40.0
+        cur = sim.world_state(TEAM_RADIANT)
+        r_default, _ = shaped_reward(prev, cur, player_id=0)
+        boosted = dataclasses.replace(
+            RewardConfig(), last_hits=RewardConfig().last_hits * 10
+        )
+        r_boosted, comps = shaped_reward(
+            prev, cur, player_id=0, weights=dict(boosted.as_dict())
+        )
+        assert r_boosted > r_default
+        assert comps["last_hits"] == pytest.approx(
+            10 * RewardConfig().last_hits
+        )
+
     def test_win_signal_symmetric(self):
         sim = make_sim()
         prev = sim.world_state(TEAM_RADIANT)

@@ -151,6 +151,59 @@ class TestEvaluate:
         assert out["episodes"] >= 4
 
 
+class TestLeagueAnchors:
+    def test_anchor_games_pin_scripted_control(self):
+        """anchor_prob pins the opponent side of the first K games to the
+        scripted bot (control-mode override) while the rest stay
+        snapshot-controlled; PFSP attribution counts only the latter."""
+        import numpy as np
+
+        from dotaclient_tpu.actor.device_rollout import DeviceActor
+        from dotaclient_tpu.models import init_params, make_policy
+        from dotaclient_tpu.protos import dota_pb2 as pb
+
+        cfg = small_config(opponent="league")
+        cfg = dataclasses.replace(
+            cfg,
+            league=dataclasses.replace(
+                cfg.league, enabled=True, anchor_prob=0.5,
+                anchor_opponent="scripted_hard",
+            ),
+        )
+        policy = make_policy(cfg.model, cfg.obs, cfg.actions)
+        da = DeviceActor(cfg, policy, seed=0)
+        assert da.n_anchor_games == 2
+        control = np.asarray(da.state.sim.control_modes)
+        ts = cfg.env.team_size
+        assert (control[:2, ts:] == pb.CONTROL_SCRIPTED_HARD).all()
+        assert (control[2:, ts:] == pb.CONTROL_AGENT).all()
+        assert (control[:, :ts] == pb.CONTROL_AGENT).all()
+
+        params = init_params(policy, jax.random.PRNGKey(0))
+        frozen = init_params(policy, jax.random.PRNGKey(9))
+        _, stats = da.collect(params, opp_params=frozen)
+        s = jax.device_get(stats)
+        assert s["league_episodes"] <= s["episodes"]
+        assert s["league_wins"] <= s["wins"]
+
+    def test_learner_league_with_anchors_trains(self):
+        from dotaclient_tpu.train.learner import Learner
+
+        cfg = small_config(opponent="league")
+        cfg = dataclasses.replace(
+            cfg,
+            log_every=1,
+            league=dataclasses.replace(
+                cfg.league, enabled=True, snapshot_every=2, pool_size=2,
+                selfplay_prob=0.0, anchor_prob=0.5,
+            ),
+        )
+        learner = Learner(cfg, actor="fused", seed=2)
+        out = learner.train(3)
+        assert np.isfinite(out["loss"])
+        assert out["optimizer_steps"] == 3.0
+
+
 class TestEvalCli:
     def test_eval_from_checkpoint_and_vs_checkpoint(self, tmp_path, capsys):
         """`python -m dotaclient_tpu.league`: restore a run's checkpoint by
