@@ -34,6 +34,24 @@ def main() -> None:
     p.add_argument("--seed", type=int, default=1)
     p.add_argument("--n-envs", type=int, default=128)
     p.add_argument("--eval-games", type=int, default=64)
+    p.add_argument("--team-size", type=int, default=1,
+                   help="heroes per side: 1 (1v1 demo), 2, or 5 "
+                   "(the BASELINE config-5 game shape)")
+    p.add_argument("--max-dota-time", type=float, default=300.0,
+                   help="episode horizon in game seconds (timeout "
+                   "adjudication decides un-finished games)")
+    p.add_argument("--hero-pool", type=str, default=None,
+                   help="comma-separated hero ids (default: single-hero "
+                   "at team size 1, {1,2,3} otherwise)")
+    p.add_argument("--reward", type=str, default=None,
+                   help="comma-separated RewardConfig overrides, e.g. "
+                   "'win=25,tower_damage=20,last_hits=0.08' — the lever "
+                   "BASELINE.md's 5v5 probes identified (farm shaping can "
+                   "dominate the sparse push/win terms at team sizes > 1)")
+    p.add_argument("--checkpoint-dir", type=str, default=None)
+    p.add_argument("--restore", action="store_true",
+                   help="resume from the latest checkpoint in "
+                   "--checkpoint-dir instead of starting at step 0")
     p.add_argument("--logdir", type=str, default=None)
     p.add_argument("--actor", type=str, default="fused",
                    choices=("fused", "device"),
@@ -46,20 +64,49 @@ def main() -> None:
     p.add_argument("--moe-experts", type=int, default=0,
                    help="with --core transformer: experts per MoE FFN layer")
     args = p.parse_args()
+    if args.restore and not args.checkpoint_dir:
+        p.error("--restore needs --checkpoint-dir")
 
-    from dotaclient_tpu.config import default_config
+    from dotaclient_tpu.config import RewardConfig, default_config
     from dotaclient_tpu.league import evaluate
     from dotaclient_tpu.train.learner import Learner
 
+    if args.hero_pool is not None:
+        try:
+            hero_pool = tuple(int(h) for h in args.hero_pool.split(","))
+        except ValueError:
+            p.error(f"--hero-pool: not a comma-separated id list: {args.hero_pool!r}")
+        n_ids = default_config().model.n_hero_ids
+        bad = [h for h in hero_pool if not 0 <= h < n_ids]
+        if bad:
+            # out-of-range ids would silently alias via the embedding
+            # gather's clamping semantics — refuse instead
+            p.error(f"--hero-pool: ids must be in [0, {n_ids}): {bad}")
+    else:
+        hero_pool = (1,) if args.team_size == 1 else (1, 2, 3)
+    reward_over = {}
+    if args.reward:
+        valid = {f.name for f in dataclasses.fields(RewardConfig)}
+        for kv in args.reward.split(","):
+            k, _, v = kv.partition("=")
+            k = k.strip()
+            if k not in valid:
+                p.error(f"--reward: unknown component {k!r} (one of {sorted(valid)})")
+            try:
+                reward_over[k] = float(v)
+            except ValueError:
+                p.error(f"--reward: bad value for {k!r}: {v!r}")
     config = default_config()
     config = dataclasses.replace(
         config,
+        reward=dataclasses.replace(config.reward, **reward_over),
         model=dataclasses.replace(
             config.model, core=args.core, moe_experts=args.moe_experts
         ),
         env=dataclasses.replace(
             config.env, n_envs=args.n_envs, opponent="scripted_easy",
-            max_dota_time=300.0,
+            max_dota_time=args.max_dota_time, team_size=args.team_size,
+            hero_pool=hero_pool,
         ),
         buffer=dataclasses.replace(
             config.buffer, capacity_rollouts=512, min_fill=128
@@ -70,11 +117,17 @@ def main() -> None:
         log_every=10_000 if args.logdir else 1_000_000_000,
         seed=args.seed,
     )
-    learner = Learner(config, actor=args.actor, seed=args.seed, logdir=args.logdir)
+    learner = Learner(config, actor=args.actor, seed=args.seed,
+                      logdir=args.logdir, checkpoint_dir=args.checkpoint_dir,
+                      restore=args.restore)
     policy = learner.policy
+    # On --restore this snapshot is the RESTORED policy, not a step-0 init:
+    # the "init" evals then baseline the transfer/resume starting point
+    # (restored_step in the summary flags such runs).
+    restored_step = int(learner.state.step) if args.restore else 0
     init_params = jax.tree.map(lambda x: x.copy(), learner.state.params)
 
-    print("== eval: INITIAL policy ==", flush=True)
+    print(f"== eval: INITIAL policy (step {restored_step}) ==", flush=True)
     init_easy = evaluate(config, policy, init_params, "scripted_easy",
                          n_games=args.eval_games, seed=7)
     init_hard = evaluate(config, policy, init_params, "scripted_hard",
@@ -118,6 +171,9 @@ def main() -> None:
                        n_games=args.eval_games, seed=7)
     summary = {
         "steps": args.steps,
+        "team_size": args.team_size,
+        "core": args.core,
+        "restored_step": restored_step,
         "frames": args.steps * config.ppo.rollout_len * (
             learner.device_actor.n_lanes
             if args.actor == "fused"
