@@ -62,6 +62,23 @@ class CheckpointManager:
             items["pipeline"] = ocp.args.StandardSave(
                 jax.tree.map(np.asarray, pipeline)
             )
+        # A periodic (weights-only) save and the end-of-run pipeline save
+        # land on the SAME step whenever the run length is a multiple of
+        # checkpoint_every; orbax refuses to overwrite an existing step.
+        # The pipeline save strictly supersedes the weights-only one, so
+        # replace it; without new content there is nothing to add — skip.
+        if step in self._mgr.all_steps():
+            if pipeline is None:
+                return False
+            self._mgr.wait_until_finished()
+            self._mgr.delete(step)
+            # the replacement save MUST NOT be declined: with force=False
+            # orbax's should_save rejects any step <= latest, which after
+            # the delete would mean guaranteed loss of step `step`. (A
+            # crash between delete and save durability can still lose it —
+            # replace-in-place is not atomic; the periodic saves around it
+            # bound the damage to one checkpoint interval.)
+            force = True
         saved = self._mgr.save(
             step, args=ocp.args.Composite(**items), force=force
         )
@@ -93,7 +110,25 @@ class CheckpointManager:
             )
         except (KeyError, FileNotFoundError, ValueError, TypeError) as e:
             return None, f"{type(e).__name__}: {e}"
-        return restored["pipeline"], ""
+        out = restored["pipeline"]
+        # orbax StandardRestore does NOT enforce the template's shapes — a
+        # checkpoint from a different run config (say 1v1 lanes restored
+        # into a 5v5 learner) round-trips with the WRONG leaf shapes and
+        # only explodes later, deep inside a jitted rollout. Reject it
+        # here so callers degrade to weights-only, loudly.
+        mismatch = jax.tree.map(
+            lambda got, want: None
+            if np.shape(got) == np.shape(want)
+            else f"{np.shape(got)} != {np.shape(want)}",
+            out,
+            template,
+        )
+        bad = [m for m in jax.tree.leaves(
+            mismatch, is_leaf=lambda x: isinstance(x, str)
+        ) if isinstance(m, str)]
+        if bad:
+            return None, f"pipeline leaf shape mismatch: {bad[0]} (+{len(bad) - 1} more)"
+        return out, ""
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()

@@ -128,3 +128,57 @@ class TestKillAndResume:
         np.testing.assert_array_equal(
             np.asarray(batch["valid"]), np.ones_like(np.asarray(batch["valid"]))
         )
+
+    def test_aligned_periodic_and_final_save(self, tmp_path):
+        """A run whose length is a multiple of checkpoint_every must not
+        crash at the end-of-run pipeline save (the periodic save already
+        wrote that step; the pipeline save supersedes it in place)."""
+        cfg = dataclasses.replace(small_config(), checkpoint_every=2)
+        ckdir = str(tmp_path / "ck")
+        a = Learner(cfg, checkpoint_dir=ckdir, seed=4, actor="device")
+        a.train(2)  # periodic save at step 2, then forced pipeline save at 2
+        a.ckpt.wait()
+
+        # the surviving step-2 checkpoint is the pipeline-complete one
+        b = Learner(cfg, checkpoint_dir=ckdir, restore=True, actor="device")
+        assert b._host_step == 2
+        restored, reason = b.ckpt.restore_pipeline(b._pipeline_state())
+        assert restored is not None and reason == ""
+
+    def test_weights_only_resave_of_existing_step_is_noop(self, tmp_path):
+        """Re-saving an existing step without new (pipeline) content is
+        skipped rather than raising StepAlreadyExistsError."""
+        cfg = small_config()
+        ckdir = str(tmp_path / "ck")
+        from dotaclient_tpu.utils.checkpoint import CheckpointManager
+
+        a = Learner(cfg, seed=5, actor="device")
+        a.train(1)
+        mgr = CheckpointManager(ckdir)
+        assert mgr.save(a.state, cfg, force=True)
+        mgr.wait()
+        assert mgr.save(a.state, cfg, force=True) is False
+        mgr.wait()
+        assert mgr.latest_step() == int(np.asarray(a.state.step))
+
+    def test_cross_config_restore_degrades_to_weights_only(self, tmp_path):
+        """Restoring a checkpoint into a DIFFERENT game shape (1v1 pipeline
+        state into a 5v5 learner — the curriculum-transfer path) must keep
+        the weights but reject the wrong-shaped pipeline leaves; orbax's
+        StandardRestore does not enforce template shapes on its own."""
+        cfg = small_config()
+        ckdir = str(tmp_path / "ck")
+        a = Learner(cfg, checkpoint_dir=ckdir, seed=6, actor="fused")
+        a.train(1)
+        a.ckpt.wait()
+
+        big = dataclasses.replace(
+            cfg, env=dataclasses.replace(cfg.env, team_size=5)
+        )
+        b = Learner(big, checkpoint_dir=ckdir, restore=True, actor="fused")
+        assert b._host_step == 1              # weights/counters restored
+        L = b.device_actor.n_lanes
+        assert L == cfg.env.n_envs * 5
+        # actor state must be the fresh 5v5 shapes, not the 1v1 leaves
+        assert b.device_actor.state.carry[0].shape[0] == L
+        b.train(1)                            # and the fused step must run
