@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -67,6 +68,7 @@ class Learner:
         logdir: Optional[str] = None,
         checkpoint_dir: Optional[str] = None,
         restore: bool = False,
+        init_from: Optional[str] = None,
         seed: int = 0,
         vec: bool = True,
         actor: Optional[str] = None,
@@ -129,6 +131,63 @@ class Learner:
         self.state = init_train_state(params, config.ppo)
         self.ckpt: Optional[CheckpointManager] = None
         self._want_restore = restore
+        self._init_from_step = 0   # source step when seeded via init_from
+        if init_from:
+            if restore:
+                raise ValueError(
+                    "init_from seeds a FRESH run from a source checkpoint; "
+                    "restore resumes this run's own checkpoint_dir — "
+                    "they are mutually exclusive"
+                )
+            if checkpoint_dir and (
+                os.path.abspath(init_from) == os.path.abspath(checkpoint_dir)
+            ):
+                raise ValueError(
+                    "init_from must point at a SEPARATE source directory: "
+                    "seeding resets the step counter to 0, so writing into "
+                    "the source dir would decline every periodic save "
+                    "(step <= latest) and the end-of-run save would destroy "
+                    "the source snapshot"
+                )
+            # Weights-only seed from a SEPARATE source directory: the run's
+            # own checkpoint_dir stays the destination, so its rolling
+            # garbage collection can never eat the source snapshot (the
+            # failure mode of resuming curriculum stages in one directory).
+            # Optimizer moments and counters start FRESH: restored Adam
+            # second moments are calibrated to the SOURCE config's gradient
+            # scales and can catastrophically over-step the transferred
+            # policy in the first updates. (The source's opt_state is read
+            # and discarded — a few MB at these model sizes; not worth a
+            # partial-restore template.)
+            src = CheckpointManager(init_from)
+            try:
+                seeded, _ = src.restore(config, self.state)
+            except (KeyError, ValueError, TypeError) as e:
+                raise ValueError(
+                    f"init_from checkpoint at {init_from!r} does not match "
+                    f"this run's model structure (different core?): {e}"
+                ) from e
+            finally:
+                src.close()
+            want = jax.eval_shape(lambda: self.state.params)
+            bad = jax.tree.leaves(
+                jax.tree.map(
+                    lambda g, w: None if g.shape == w.shape else
+                    f"{g.shape} != {w.shape}",
+                    seeded.params, want,
+                ),
+                is_leaf=lambda x: isinstance(x, str),
+            )
+            bad = [b for b in bad if isinstance(b, str)]
+            if bad:
+                raise ValueError(
+                    f"init_from checkpoint is incompatible with this run's "
+                    f"model config (param shape {bad[0]}, +{len(bad) - 1} "
+                    f"more mismatches) — was it trained with a different "
+                    f"core/width?"
+                )
+            self.state = init_train_state(seeded.params, config.ppo)
+            self._init_from_step = int(np.asarray(seeded.step))
         if checkpoint_dir:
             self.ckpt = CheckpointManager(checkpoint_dir)
             if restore and self.ckpt.latest_step() is not None:
@@ -640,6 +699,10 @@ def main(argv=None) -> Dict[str, float]:
     p.add_argument("--logdir", type=str, default=None)
     p.add_argument("--checkpoint-dir", type=str, default=None)
     p.add_argument("--restore", action="store_true")
+    p.add_argument("--init-from", type=str, default=None, metavar="DIR",
+                   help="seed a fresh run with the params of the latest "
+                   "checkpoint in DIR (source stays untouched; mutually "
+                   "exclusive with --restore)")
     p.add_argument("--n-envs", type=int, default=None)
     p.add_argument("--opponent", type=str, default=None)
     p.add_argument("--team-size", type=int, default=None)
@@ -788,6 +851,7 @@ def main(argv=None) -> Dict[str, float]:
         logdir=args.logdir,
         checkpoint_dir=args.checkpoint_dir,
         restore=args.restore,
+        init_from=args.init_from,
         seed=args.seed,
         actor=args.actor or ("scalar" if args.no_vec else "device"),
         debug_checkify=args.checkify,

@@ -43,6 +43,19 @@ def main() -> None:
     p.add_argument("--hero-pool", type=str, default=None,
                    help="comma-separated hero ids (default: single-hero "
                    "at team size 1, {1,2,3} otherwise)")
+    p.add_argument("--opponent", type=str, default="scripted_easy",
+                   choices=("scripted_easy", "scripted_hard", "selfplay"),
+                   help="training opponent (evals always measure both "
+                   "scripted bots); fine-tune stages should train against "
+                   "an opponent the policy does NOT already beat — a "
+                   "near-optimal matchup has ~zero advantage signal")
+    p.add_argument("--ppo", type=str, default=None,
+                   help="comma-separated PPOConfig overrides, e.g. "
+                   "'entropy_coef=0.001,learning_rate=1e-4' — fine-tune "
+                   "stages need weaker entropy pressure than from-scratch "
+                   "runs (a near-optimal policy has ~zero advantage signal, "
+                   "so the entropy bonus becomes the dominant gradient and "
+                   "re-randomizes it)")
     p.add_argument("--reward", type=str, default=None,
                    help="comma-separated RewardConfig overrides, e.g. "
                    "'win=25,tower_damage=20,last_hits=0.08' — the lever "
@@ -52,6 +65,12 @@ def main() -> None:
     p.add_argument("--restore", action="store_true",
                    help="resume from the latest checkpoint in "
                    "--checkpoint-dir instead of starting at step 0")
+    p.add_argument("--init-from", type=str, default=None, metavar="DIR",
+                   help="seed a fresh run with the params of the latest "
+                   "checkpoint in DIR; unlike --restore the source dir is "
+                   "never written to (safe curriculum staging — a stage-2 "
+                   "run resuming IN its source dir would garbage-collect "
+                   "the stage-1 snapshot)")
     p.add_argument("--logdir", type=str, default=None)
     p.add_argument("--actor", type=str, default="fused",
                    choices=("fused", "device"),
@@ -66,8 +85,10 @@ def main() -> None:
     args = p.parse_args()
     if args.restore and not args.checkpoint_dir:
         p.error("--restore needs --checkpoint-dir")
+    if args.init_from and args.restore:
+        p.error("--init-from and --restore are mutually exclusive")
 
-    from dotaclient_tpu.config import RewardConfig, default_config
+    from dotaclient_tpu.config import PPOConfig, RewardConfig, default_config
     from dotaclient_tpu.league import evaluate
     from dotaclient_tpu.train.learner import Learner
 
@@ -84,27 +105,38 @@ def main() -> None:
             p.error(f"--hero-pool: ids must be in [0, {n_ids}): {bad}")
     else:
         hero_pool = (1,) if args.team_size == 1 else (1, 2, 3)
-    reward_over = {}
-    if args.reward:
-        valid = {f.name for f in dataclasses.fields(RewardConfig)}
-        for kv in args.reward.split(","):
+    def parse_overrides(flag: str, text: str, cls) -> dict:
+        fields = {f.name: f.type for f in dataclasses.fields(cls)}
+        out = {}
+        for kv in text.split(","):
             k, _, v = kv.partition("=")
             k = k.strip()
-            if k not in valid:
-                p.error(f"--reward: unknown component {k!r} (one of {sorted(valid)})")
+            if k not in fields:
+                p.error(f"{flag}: unknown field {k!r} (one of {sorted(fields)})")
+            caster = int if fields[k] in (int, "int") else float
             try:
-                reward_over[k] = float(v)
+                out[k] = caster(v)
             except ValueError:
-                p.error(f"--reward: bad value for {k!r}: {v!r}")
+                p.error(f"{flag}: bad {caster.__name__} for {k!r}: {v!r}")
+        return out
+
+    reward_over = (
+        parse_overrides("--reward", args.reward, RewardConfig)
+        if args.reward else {}
+    )
+    ppo_over = (
+        parse_overrides("--ppo", args.ppo, PPOConfig) if args.ppo else {}
+    )
     config = default_config()
     config = dataclasses.replace(
         config,
         reward=dataclasses.replace(config.reward, **reward_over),
+        ppo=dataclasses.replace(config.ppo, **ppo_over),
         model=dataclasses.replace(
             config.model, core=args.core, moe_experts=args.moe_experts
         ),
         env=dataclasses.replace(
-            config.env, n_envs=args.n_envs, opponent="scripted_easy",
+            config.env, n_envs=args.n_envs, opponent=args.opponent,
             max_dota_time=args.max_dota_time, team_size=args.team_size,
             hero_pool=hero_pool,
         ),
@@ -119,12 +151,15 @@ def main() -> None:
     )
     learner = Learner(config, actor=args.actor, seed=args.seed,
                       logdir=args.logdir, checkpoint_dir=args.checkpoint_dir,
-                      restore=args.restore)
+                      restore=args.restore, init_from=args.init_from)
     policy = learner.policy
     # On --restore this snapshot is the RESTORED policy, not a step-0 init:
     # the "init" evals then baseline the transfer/resume starting point
-    # (restored_step in the summary flags such runs).
+    # (restored_step in the summary flags such runs; weights-only transfer
+    # resets the counter, so report the restore as such).
     restored_step = int(learner.state.step) if args.restore else 0
+    if args.init_from:
+        restored_step = learner._init_from_step
     init_params = jax.tree.map(lambda x: x.copy(), learner.state.params)
 
     print(f"== eval: INITIAL policy (step {restored_step}) ==", flush=True)
@@ -135,7 +170,7 @@ def main() -> None:
     print(f"init vs easy: {init_easy}", flush=True)
     print(f"init vs hard: {init_hard}", flush=True)
 
-    print(f"== train: {args.steps} optimizer steps vs scripted_easy ==", flush=True)
+    print(f"== train: {args.steps} optimizer steps vs {args.opponent} ==", flush=True)
     t0 = time.time()
     block = 1000
     curve = []

@@ -182,3 +182,58 @@ class TestKillAndResume:
         # actor state must be the fresh 5v5 shapes, not the 1v1 leaves
         assert b.device_actor.state.carry[0].shape[0] == L
         b.train(1)                            # and the fused step must run
+
+    def test_init_from_seeds_weights_fresh_run(self, tmp_path):
+        """init_from seeds params from a SOURCE dir, starts counters and
+        optimizer fresh, never writes to the source, and is mutually
+        exclusive with restore."""
+        cfg = small_config()
+        src_dir = str(tmp_path / "src")
+        a = Learner(cfg, checkpoint_dir=src_dir, seed=7, actor="fused")
+        a.train(1)
+        a.ckpt.wait()
+        src_steps = set(a.ckpt._mgr.all_steps())
+
+        big = dataclasses.replace(
+            cfg, env=dataclasses.replace(cfg.env, team_size=5)
+        )
+        dst_dir = str(tmp_path / "dst")
+        b = Learner(big, checkpoint_dir=dst_dir, init_from=src_dir,
+                    actor="fused")
+        assert b._host_step == 0 and b._init_from_step == 1
+        # seeded params == source params, optimizer moments fresh
+        for la, lb in zip(
+            jax.tree.leaves(a.state.params), jax.tree.leaves(b.state.params)
+        ):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+        b.train(1)
+        b.ckpt.wait()
+        # destination got b's own checkpoint; source untouched
+        assert b.ckpt.latest_step() == 1
+        from dotaclient_tpu.utils.checkpoint import CheckpointManager
+
+        src_check = CheckpointManager(src_dir)
+        assert set(src_check._mgr.all_steps()) == src_steps
+
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            Learner(big, checkpoint_dir=dst_dir, restore=True,
+                    init_from=src_dir, actor="fused")
+
+    def test_init_from_rejects_same_dir_and_wrong_core(self, tmp_path):
+        cfg = small_config()
+        src_dir = str(tmp_path / "src")
+        a = Learner(cfg, checkpoint_dir=src_dir, seed=8, actor="fused")
+        a.train(1)
+        a.ckpt.wait()
+
+        with pytest.raises(ValueError, match="SEPARATE source"):
+            Learner(cfg, checkpoint_dir=src_dir, init_from=src_dir,
+                    actor="fused")
+
+        other_core = dataclasses.replace(
+            cfg, model=dataclasses.replace(
+                cfg.model, core="transformer", n_layers=1, context_window=4
+            ),
+        )
+        with pytest.raises(ValueError, match="init_from checkpoint"):
+            Learner(other_core, init_from=src_dir, actor="fused")
