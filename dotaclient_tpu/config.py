@@ -64,6 +64,11 @@ class ModelConfig:
     param_dtype: str = "float32"
 
 
+# Valid PPOConfig.adv_norm values — the single source of truth for the
+# runtime check in train.ppo and any CLI-level validation.
+ADV_NORM_MODES = ("batch", "none")
+
+
 @dataclasses.dataclass(frozen=True)
 class PPOConfig:
     gamma: float = 0.99
@@ -79,6 +84,25 @@ class PPOConfig:
     minibatches: int = 1         # shuffled minibatch splits per epoch
     max_staleness: int = 4       # drop rollouts older than this many BATCHES
     moe_aux_coef: float = 0.01   # Switch load-balancing loss weight (MoE core)
+    # Advantage normalization. "batch" (the standard per-batch whitening) is
+    # right for training from scratch, but it amplifies GAE noise to unit
+    # scale when the true advantage signal is ~zero — measured to destroy a
+    # near-optimal transferred policy within ~1k steps (BASELINE.md, 5v5
+    # curriculum). adv_norm_floor puts a lower bound on the divisor so small
+    # advantages stay small: floor 0.0 reproduces the standard behavior,
+    # floor 1.0 means "whiten only when the batch std exceeds unit scale".
+    # adv_norm="none" centers but never rescales.
+    adv_norm: str = "batch"      # one of ADV_NORM_MODES
+    adv_norm_floor: float = 0.0
+    # Critic-only warmup: for the first N optimizer steps, train ONLY the
+    # value head (policy surrogate + entropy off; all non-value-head grads
+    # masked to zero, so the behavior policy is bitwise frozen). The
+    # curriculum-transfer lever: after --init-from the transferred critic is
+    # calibrated to the SOURCE config's returns (team size, reward weights,
+    # gamma), so early advantages are systematically wrong and can destroy a
+    # near-optimal policy before the critic adapts (BASELINE.md 5v5
+    # fine-tune measurements). 0 disables.
+    value_warmup_steps: int = 0
 
     @property
     def steps_per_batch(self) -> int:

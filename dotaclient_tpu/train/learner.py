@@ -54,7 +54,7 @@ from dotaclient_tpu.transport import (
     decode_rollout,
     encode_weights,
 )
-from dotaclient_tpu.utils.checkpoint import CheckpointManager
+from dotaclient_tpu.utils.checkpoint import CheckpointManager, shape_mismatches
 from dotaclient_tpu.utils.metrics import MetricsLogger
 
 
@@ -140,7 +140,7 @@ class Learner:
                     "they are mutually exclusive"
                 )
             if checkpoint_dir and (
-                os.path.abspath(init_from) == os.path.abspath(checkpoint_dir)
+                os.path.realpath(init_from) == os.path.realpath(checkpoint_dir)
             ):
                 raise ValueError(
                     "init_from must point at a SEPARATE source directory: "
@@ -159,6 +159,13 @@ class Learner:
             # policy in the first updates. (The source's opt_state is read
             # and discarded — a few MB at these model sizes; not worth a
             # partial-restore template.)
+            if not os.path.isdir(init_from):
+                # Constructing the manager would CREATE the missing dir
+                # (orbax create=True) — a mistyped path must fail cleanly,
+                # not leave a stray empty checkpoint tree masking the typo.
+                raise FileNotFoundError(
+                    f"init_from directory does not exist: {init_from!r}"
+                )
             src = CheckpointManager(init_from)
             try:
                 seeded, _ = src.restore(config, self.state)
@@ -170,15 +177,7 @@ class Learner:
             finally:
                 src.close()
             want = jax.eval_shape(lambda: self.state.params)
-            bad = jax.tree.leaves(
-                jax.tree.map(
-                    lambda g, w: None if g.shape == w.shape else
-                    f"{g.shape} != {w.shape}",
-                    seeded.params, want,
-                ),
-                is_leaf=lambda x: isinstance(x, str),
-            )
-            bad = [b for b in bad if isinstance(b, str)]
+            bad = shape_mismatches(seeded.params, want)
             if bad:
                 raise ValueError(
                     f"init_from checkpoint is incompatible with this run's "

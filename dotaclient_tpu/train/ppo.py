@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dotaclient_tpu.config import PPOConfig, RunConfig
+from dotaclient_tpu.config import ADV_NORM_MODES, PPOConfig, RunConfig
 from dotaclient_tpu.models import distributions as D
 from dotaclient_tpu.models.policy import Policy
 from dotaclient_tpu.train.gae import gae
@@ -106,8 +106,16 @@ def ppo_loss(
     params: Any,
     batch: Batch,
     cfg: PPOConfig,
+    step: Any = None,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
-    """Clipped-surrogate PPO loss over a batch of rollout chunks."""
+    """Clipped-surrogate PPO loss over a batch of rollout chunks.
+
+    ``step`` (the optimizer-step counter) enables the critic-only warmup
+    window: while ``step < cfg.value_warmup_steps`` the policy surrogate,
+    entropy bonus, and MoE aux terms are switched off so only the value
+    loss trains (see PPOConfig.value_warmup_steps; the matching gradient
+    mask in ``_train_step`` keeps the rest of the network bitwise frozen).
+    """
     obs = batch["obs"]
     T = batch["rewards"].shape[1]
     valid = batch["valid"].astype(jnp.float32)
@@ -130,10 +138,20 @@ def ppo_loss(
         cfg.gamma,
         cfg.gae_lambda,
     )
-    # Standard PPO advantage normalization over the (valid) batch.
+    # Advantage normalization over the (valid) batch. Always centered;
+    # rescaled per cfg.adv_norm — the floor keeps near-zero advantage
+    # batches from being blown up to unit scale (cfg comment, BASELINE.md
+    # 5v5 fine-tune measurement).
     adv_mean = (adv * valid).sum() / n_valid
-    adv_var = (jnp.square(adv - adv_mean) * valid).sum() / n_valid
-    adv = (adv - adv_mean) * jax.lax.rsqrt(adv_var + 1e-8)
+    adv = adv - adv_mean
+    if cfg.adv_norm == "batch":
+        adv_var = (jnp.square(adv) * valid).sum() / n_valid
+        adv_std = jnp.sqrt(adv_var + 1e-8)
+        adv = adv / jnp.maximum(adv_std, cfg.adv_norm_floor)
+    elif cfg.adv_norm not in ADV_NORM_MODES:
+        raise ValueError(
+            f"unknown adv_norm {cfg.adv_norm!r} (one of {ADV_NORM_MODES})"
+        )
 
     logp = D.log_prob(logits_t, obs_t, batch["actions"])
     ratio = jnp.exp(logp - batch["behavior_logp"])
@@ -143,11 +161,14 @@ def ppo_loss(
     value_loss = 0.5 * (jnp.square(values_t - returns) * valid).sum() / n_valid
     ent = (D.entropy(logits_t, obs_t) * valid).sum() / n_valid
 
+    if cfg.value_warmup_steps and step is not None:
+        policy_on = (step >= cfg.value_warmup_steps).astype(jnp.float32)
+    else:
+        policy_on = 1.0
     loss = (
-        policy_loss
+        policy_on
+        * (policy_loss - cfg.entropy_coef * ent + cfg.moe_aux_coef * moe_aux)
         + cfg.value_coef * value_loss
-        - cfg.entropy_coef * ent
-        + cfg.moe_aux_coef * moe_aux
     )
     metrics = {
         "loss": loss,
@@ -169,11 +190,48 @@ def _train_step(
     policy: Policy, cfg: PPOConfig, state: TrainState, batch: Batch
 ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
     grad_fn = jax.value_and_grad(
-        lambda p: ppo_loss(policy, p, batch, cfg), has_aux=True
+        lambda p: ppo_loss(policy, p, batch, cfg, step=state.step),
+        has_aux=True,
     )
     (_, metrics), grads = grad_fn(state.params)
+    if cfg.value_warmup_steps:
+        # Critic-only warmup: zero every gradient outside the value head so
+        # the behavior policy is EXACTLY frozen (value-loss gradients still
+        # flow through the shared trunk otherwise). The head itself keeps
+        # its full gradient and recalibrates to this config's returns.
+        policy_on = (state.step >= cfg.value_warmup_steps).astype(jnp.float32)
+
+        def _mask(path, g):
+            in_value_head = any(
+                getattr(k, "key", None) == "head_value" for k in path
+            )
+            # astype(g.dtype): a float32 scalar would silently promote
+            # bfloat16 grads (and with them Adam's moments) to float32,
+            # retracing the donated step and skewing checkpoint templates.
+            return g if in_value_head else g * policy_on.astype(g.dtype)
+
+        grads = jax.tree_util.tree_map_with_path(_mask, grads)
     opt = make_optimizer(cfg)
-    updates, opt_state = opt.update(grads, state.opt_state, state.params)
+    opt_state_in = state.opt_state
+    if cfg.value_warmup_steps:
+        # At the warmup boundary, re-init the optimizer state: the frozen
+        # params sat out the warmup with zero moments while Adam's shared
+        # step count advanced, so their bias correction is desynchronized —
+        # the first post-warmup update would be ~(1-b1)/sqrt(1-b2) ≈ 3×
+        # oversized across every policy param at once, exactly the
+        # destroy-the-transferred-policy kick this feature exists to
+        # prevent. A fresh opt_state makes the first live step behave like
+        # a fresh optimizer's first step. (The value head's moments reset
+        # too — harmless, it has converged toward this config's returns by
+        # then.) jnp.where keeps the opt_state structure unchanged, so
+        # checkpoints stay layout-compatible.
+        at_boundary = state.step == cfg.value_warmup_steps
+        fresh = opt.init(state.params)
+        opt_state_in = jax.tree.map(
+            lambda f, cur: jnp.where(at_boundary, f, cur),
+            fresh, opt_state_in,
+        )
+    updates, opt_state = opt.update(grads, opt_state_in, state.params)
     params = optax.apply_updates(state.params, updates)
     metrics["grad_norm"] = optax.global_norm(grads)
     new_state = dataclasses.replace(

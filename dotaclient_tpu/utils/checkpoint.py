@@ -21,6 +21,25 @@ from dotaclient_tpu.config import RunConfig
 from dotaclient_tpu.train.ppo import TrainState, init_train_state
 
 
+def shape_mismatches(got: Any, want: Any) -> list:
+    """Leaf-by-leaf shape comparison of two same-structure pytrees; returns
+    human-readable ``"(got) != (want)"`` strings for every mismatched leaf.
+    Shared by the pipeline restore below and the learner's ``init_from``
+    compatibility check so the validation idiom cannot drift."""
+    tree = jax.tree.map(
+        lambda g, w: None
+        if np.shape(g) == np.shape(w)
+        else f"{np.shape(g)} != {np.shape(w)}",
+        got,
+        want,
+    )
+    return [
+        m
+        for m in jax.tree.leaves(tree, is_leaf=lambda x: isinstance(x, str))
+        if isinstance(m, str)
+    ]
+
+
 class CheckpointManager:
     """Thin orbax wrapper with the repo's state layout."""
 
@@ -116,16 +135,7 @@ class CheckpointManager:
         # into a 5v5 learner) round-trips with the WRONG leaf shapes and
         # only explodes later, deep inside a jitted rollout. Reject it
         # here so callers degrade to weights-only, loudly.
-        mismatch = jax.tree.map(
-            lambda got, want: None
-            if np.shape(got) == np.shape(want)
-            else f"{np.shape(got)} != {np.shape(want)}",
-            out,
-            template,
-        )
-        bad = [m for m in jax.tree.leaves(
-            mismatch, is_leaf=lambda x: isinstance(x, str)
-        ) if isinstance(m, str)]
+        bad = shape_mismatches(out, template)
         if bad:
             return None, f"pipeline leaf shape mismatch: {bad[0]} (+{len(bad) - 1} more)"
         return out, ""

@@ -88,7 +88,9 @@ def main() -> None:
     if args.init_from and args.restore:
         p.error("--init-from and --restore are mutually exclusive")
 
-    from dotaclient_tpu.config import PPOConfig, RewardConfig, default_config
+    from dotaclient_tpu.config import (
+        ADV_NORM_MODES, PPOConfig, RewardConfig, default_config,
+    )
     from dotaclient_tpu.league import evaluate
     from dotaclient_tpu.train.learner import Learner
 
@@ -113,11 +115,24 @@ def main() -> None:
             k = k.strip()
             if k not in fields:
                 p.error(f"{flag}: unknown field {k!r} (one of {sorted(fields)})")
-            caster = int if fields[k] in (int, "int") else float
+            if fields[k] in (str, "str"):
+                caster = str
+            elif fields[k] in (int, "int"):
+                caster = int
+            else:
+                caster = float
             try:
-                out[k] = caster(v)
+                out[k] = caster(v.strip())
             except ValueError:
                 p.error(f"{flag}: bad {caster.__name__} for {k!r}: {v!r}")
+        # Validate enum-like string fields at parse time: a typo must die
+        # here, not minutes later at the first train-step trace (after both
+        # initial evals have burned TPU wall-clock).
+        if out.get("adv_norm") is not None and out["adv_norm"] not in ADV_NORM_MODES:
+            p.error(
+                f"{flag}: adv_norm must be one of {ADV_NORM_MODES}, "
+                f"got {out['adv_norm']!r}"
+            )
         return out
 
     reward_over = (
