@@ -358,6 +358,7 @@ class DeviceActor:
             "win_rate": (
                 self.wins / self.episodes_done if self.episodes_done else 0.0
             ),
+            "episodes_recent": r_eps,
             "win_rate_recent": recent.get("wins", 0.0) / r_eps if r_eps else 0.0,
             "ep_reward_recent": (
                 recent.get("ep_return_sum", 0.0) / r_eps if r_eps else 0.0

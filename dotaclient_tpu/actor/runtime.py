@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dotaclient_tpu.actor.window_stats import WindowedStatsMixin
 from dotaclient_tpu.config import RunConfig
 from dotaclient_tpu.envs.env_api import LocalDotaEnv
 from dotaclient_tpu.envs import lane_sim
@@ -115,7 +116,7 @@ class _Lane:
     episode_reward: float = 0.0
 
 
-class ActorPool:
+class ActorPool(WindowedStatsMixin):
     """N-lane batched actor.
 
     ``opponent="selfplay"`` makes every hero an agent lane sharing the same
@@ -450,4 +451,5 @@ class ActorPool:
             "win_rate": (
                 self.wins / self.episodes_done if self.episodes_done else 0.0
             ),
+            **self.windowed_entries(),
         }

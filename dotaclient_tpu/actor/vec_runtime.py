@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dotaclient_tpu.actor.window_stats import WindowedStatsMixin
 from dotaclient_tpu.config import RunConfig
 from dotaclient_tpu.envs.vec_lane_sim import (
     OPPONENT_CONTROL,
@@ -63,7 +64,7 @@ def make_device_step(policy: Policy):
     return jax.jit(_step)
 
 
-class VecActorPool:
+class VecActorPool(WindowedStatsMixin):
     """Batched actor over a vectorized sim. Public surface matches
     ``ActorPool`` (step/run/stats/set_params/refresh_weights/params/version).
     """
@@ -366,6 +367,7 @@ class VecActorPool:
             "win_rate": (
                 self.wins / self.episodes_done if self.episodes_done else 0.0
             ),
+            **self.windowed_entries(),
         }
 
 

@@ -1,0 +1,52 @@
+"""Windowed episode stats shared by the host-driven actor pools.
+
+``DeviceActor`` accumulates its window on-device and drains it in one host
+sync; the host pools (``ActorPool``, ``VecActorPool``) already keep their
+episode counters on the host, so their window is just a delta against the
+counters at the previous drain. Same drain cadence, same ``*_recent`` keys —
+which is what lets the learner's best-model checkpointing
+(``Learner._maybe_save_best``) work identically across all actor modes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+
+class WindowedStatsMixin:
+    """Mixin over a pool exposing ``episodes_done``/``wins`` counters and an
+    append-only ``episode_rewards`` list. Provides ``drain_stats()`` and the
+    windowed entries merged into ``stats()`` via ``windowed_entries()``."""
+
+    # set lazily so __init__ orders don't matter
+    _win_base_eps = 0
+    _win_base_wins = 0
+    _win_base_ret_idx = 0
+
+    def drain_stats(self) -> Dict[str, float]:
+        """Close the current window (since the previous drain) and return
+        ``stats()`` with the fresh window in the ``*_recent`` keys."""
+        self._recent_window = {
+            "episodes": float(self.episodes_done - self._win_base_eps),
+            "wins": float(self.wins - self._win_base_wins),
+            "ep_return_sum": float(
+                sum(self.episode_rewards[self._win_base_ret_idx:])
+            ),
+        }
+        self._win_base_eps = self.episodes_done
+        self._win_base_wins = self.wins
+        self._win_base_ret_idx = len(self.episode_rewards)
+        return self.stats()
+
+    def windowed_entries(self) -> Dict[str, float]:
+        recent = getattr(self, "_recent_window", None) or {}
+        r_eps = recent.get("episodes", 0.0)
+        return {
+            "episodes_recent": r_eps,
+            "win_rate_recent": (
+                recent.get("wins", 0.0) / r_eps if r_eps else 0.0
+            ),
+            "ep_reward_recent": (
+                recent.get("ep_return_sum", 0.0) / r_eps if r_eps else 0.0
+            ),
+        }

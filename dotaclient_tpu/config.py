@@ -103,6 +103,22 @@ class PPOConfig:
     # near-optimal policy before the critic adapts (BASELINE.md 5v5
     # fine-tune measurements). 0 disables.
     value_warmup_steps: int = 0
+    # KL-adaptive learning rate (trust-region-style auto-stabilizer).
+    # When kl_target > 0, every train step measures the POST-update KL
+    # (k3 estimator over the batch's taken actions) inside the compiled
+    # step and adapts the Adam learning rate carried in the optimizer
+    # state: ×kl_lr_down when KL > 2·target, ×kl_lr_up when KL <
+    # target/2, clipped to [learning_rate·kl_lr_min_scale,
+    # learning_rate·kl_lr_max_scale]. Fully in-graph — no host sync — so
+    # it works in fused mode. Motivating measurement: 5v5 fine-tune
+    # collapses at lr 3e-4 but ascends at 1e-5 (BASELINE.md); this makes
+    # step size self-tuning instead of a per-run guess. 0 disables
+    # (plain constant-lr Adam; optimizer-state layout unchanged).
+    kl_target: float = 0.0
+    kl_lr_down: float = 0.7
+    kl_lr_up: float = 1.02
+    kl_lr_min_scale: float = 0.01
+    kl_lr_max_scale: float = 10.0
 
     @property
     def steps_per_batch(self) -> int:
@@ -210,6 +226,14 @@ class RunConfig:
     league: LeagueConfig = LeagueConfig()
     checkpoint_dir: str = "checkpoints"
     checkpoint_every: int = 100
+    # Best-model tracking: whenever the windowed win-rate at a log boundary
+    # beats the best seen so far (and the window holds at least this many
+    # episodes — the noise guard), a weights-only checkpoint is saved to
+    # `<checkpoint_dir>/best` (its own max_to_keep=1 rotation). Motivated by
+    # the measured 5v5 fine-tune trajectory that peaked at 0.714 mid-run and
+    # ended at 0.16 — the peak policy otherwise rotates out of the periodic
+    # checkpoints (BASELINE.md). 0 disables.
+    checkpoint_best_min_episodes: int = 50
     log_every: int = 10
     seed: int = 0
 
@@ -233,6 +257,11 @@ class RunConfig:
             mesh=MeshConfig(**raw["mesh"]),
             buffer=BufferConfig(**raw["buffer"]),
             league=LeagueConfig(**raw["league"]),
+            # .get: absent in checkpoints written before the field existed
+            checkpoint_best_min_episodes=raw.get(
+                "checkpoint_best_min_episodes",
+                cls.checkpoint_best_min_episodes,
+            ),
             **{k: raw[k] for k in ("checkpoint_dir", "checkpoint_every", "log_every", "seed")},
         )
 

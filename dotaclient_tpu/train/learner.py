@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import json
 import os
 import threading
 import time
@@ -168,7 +169,11 @@ class Learner:
                 )
             src = CheckpointManager(init_from)
             try:
-                seeded, _ = src.restore(config, self.state)
+                # Weights-only (template-free) restore: init_from must work
+                # across optimizer configs — a plain-Adam source seeding a
+                # KL-adaptive run has a different opt_state layout, and the
+                # moments are discarded here anyway.
+                seeded_params, seeded_step = src.restore_weights()
             except (KeyError, ValueError, TypeError) as e:
                 raise ValueError(
                     f"init_from checkpoint at {init_from!r} does not match "
@@ -177,7 +182,7 @@ class Learner:
             finally:
                 src.close()
             want = jax.eval_shape(lambda: self.state.params)
-            bad = shape_mismatches(seeded.params, want)
+            bad = shape_mismatches(seeded_params, want)
             if bad:
                 raise ValueError(
                     f"init_from checkpoint is incompatible with this run's "
@@ -185,12 +190,57 @@ class Learner:
                     f"more mismatches) — was it trained with a different "
                     f"core/width?"
                 )
-            self.state = init_train_state(seeded.params, config.ppo)
-            self._init_from_step = int(np.asarray(seeded.step))
+            self.state = init_train_state(seeded_params, config.ppo)
+            self._init_from_step = seeded_step
+        self.ckpt_best: Optional[CheckpointManager] = None
+        self._best_dir: Optional[str] = None
+        self._best_win = -1.0
         if checkpoint_dir:
             self.ckpt = CheckpointManager(checkpoint_dir)
             if restore and self.ckpt.latest_step() is not None:
-                self.state, _ = self.ckpt.restore(config, self.state)
+                try:
+                    self.state, _ = self.ckpt.restore(config, self.state)
+                except ValueError as e:
+                    # The only layout-changing PPO knob today is kl_target
+                    # (inject_hyperparams adds an lr leaf to opt_state) —
+                    # translate orbax's raw tree diff into the fix.
+                    raise ValueError(
+                        f"checkpoint at {checkpoint_dir!r} does not match "
+                        f"this run's OPTIMIZER layout — toggling "
+                        f"ppo.kl_target between a run and its --restore "
+                        f"changes the opt_state structure. Restore with "
+                        f"the original setting, or re-seed weights-only "
+                        f"via --init-from. ({e})"
+                    ) from e
+            if config.checkpoint_best_min_episodes > 0:
+                # Best-model rotation (see RunConfig.checkpoint_best_min_
+                # episodes): the mid-run peak survives even when training
+                # later slides off it. The manager is created lazily at the
+                # first qualifying save (actor modes without windowed
+                # win-rate stats would otherwise leave a stray empty tree),
+                # but the best-so-far value must load EAGERLY: a resumed
+                # run that reset it to -1 would let its first (possibly
+                # collapsed) window overwrite the captured peak.
+                self._best_dir = os.path.join(checkpoint_dir, "best")
+                meta = os.path.join(self._best_dir, "best_meta.json")
+                if os.path.exists(meta):
+                    try:
+                        with open(meta) as f:
+                            self._best_win = float(
+                                json.load(f)["win_rate_recent"]
+                            )
+                    except (OSError, ValueError, KeyError):
+                        # Unreadable meta + a resumed collapsed run would
+                        # let the first window displace the captured peak;
+                        # +inf freezes the rotation until the operator
+                        # inspects/removes best/ (loud, not silent).
+                        print(
+                            f"WARNING: {meta} unreadable — best-model "
+                            f"rotation FROZEN to protect the existing "
+                            f"best/ checkpoint; delete the dir to reset",
+                            flush=True,
+                        )
+                        self._best_win = float("inf")
         self.train_step = make_train_step(
             self.policy, config, self.mesh, debug_checkify=debug_checkify
         )
@@ -491,6 +541,37 @@ class Learner:
         )
         self.pool.set_opponent(params, version)
 
+    def _maybe_save_best(self, scalars: Dict[str, float]) -> None:
+        """Best-model rotation: save weights to ``<checkpoint_dir>/best``
+        when the windowed win-rate beats the best seen, with the
+        ``checkpoint_best_min_episodes`` noise guard (RunConfig comment)."""
+        if self._best_dir is None:
+            return
+        wr = scalars.get("win_rate_recent")
+        eps = scalars.get("episodes_recent", 0.0)
+        if (
+            wr is None
+            or eps < self.config.checkpoint_best_min_episodes
+            or wr <= self._best_win
+        ):
+            return
+        if self.ckpt_best is None:
+            self.ckpt_best = CheckpointManager(self._best_dir, max_to_keep=1)
+        # An orbax-declined save (resumed run whose step counter sits below
+        # the captured peak's step) must not advance the best marker.
+        if self.ckpt_best.save(self.state, self.config):
+            self._best_win = wr
+            # temp+rename: the orbax save is atomic, the sidecar must be
+            # too — a kill mid-write would otherwise reset the marker on
+            # resume and let a collapsed window rotate out the peak.
+            meta = os.path.join(self._best_dir, "best_meta.json")
+            tmp = meta + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(
+                    {"win_rate_recent": wr, "step": int(self.state.step)}, f
+                )
+            os.replace(tmp, meta)
+
     def train(
         self,
         num_steps: int,
@@ -528,7 +609,7 @@ class Learner:
                 if self.device_actor is not None:
                     scalars.update(self.device_actor.drain_stats())
                 elif self.pool is not None:
-                    scalars.update(self.pool.stats())
+                    scalars.update(self.pool.drain_stats())
                 if self.league is not None:
                     self._flush_league_reports()
                     wrs = self.league.win_rates()
@@ -539,6 +620,9 @@ class Learner:
                     scalars.update(self.buffer.metrics())
                 elapsed = time.time() - t_start
                 scalars["frames_per_sec"] = frames_trained / max(elapsed, 1e-9)
+                self._maybe_save_best(scalars)
+                if self._best_dir is not None:
+                    scalars["best_win_rate"] = self._best_win
                 self._last_metrics = scalars
                 self.metrics.log(step, scalars)
             # `< epochs` (not `== 0`): the counter advances in strides of
@@ -667,7 +751,12 @@ class Learner:
                     if steps_done >= num_steps:
                         break
         if self.device_actor is not None:
-            self.device_actor.drain_stats()
+            # End-of-call drain: the windowed stats cover this train() call
+            # (the demo's block cadence) — the second best-model hook, so
+            # peak capture works even when log_every never fires mid-call.
+            self._maybe_save_best(self.device_actor.drain_stats())
+        elif self.pool is not None:
+            self._maybe_save_best(self.pool.drain_stats())
         if self.league is not None:
             self._flush_league_reports()
         # Publish final weights for out-of-process actors (cluster parity).

@@ -51,6 +51,16 @@ Batch = Dict[str, Any]
 
 
 def make_optimizer(cfg: PPOConfig) -> optax.GradientTransformation:
+    if cfg.kl_target > 0:
+        # inject_hyperparams materializes the learning rate as an array in
+        # the optimizer state so the KL-adaptive controller in _train_step
+        # can rescale it in-graph (state layout gains one scalar leaf).
+        return optax.chain(
+            optax.clip_by_global_norm(cfg.max_grad_norm),
+            optax.inject_hyperparams(optax.adam)(
+                learning_rate=cfg.learning_rate
+            ),
+        )
     return optax.chain(
         optax.clip_by_global_norm(cfg.max_grad_norm),
         optax.adam(cfg.learning_rate),
@@ -173,6 +183,10 @@ def ppo_loss(
     metrics = {
         "loss": loss,
         "moe_aux": moe_aux,
+        # Stashed for _train_step's post-update KL measurement (popped
+        # there — never reaches the logger). Only when the KL-adaptive lr
+        # is on, to avoid carrying a [B, T] array through aux otherwise.
+        **({"_logp": logp} if cfg.kl_target > 0 else {}),
         "policy_loss": policy_loss,
         "value_loss": value_loss,
         "entropy": ent,
@@ -233,6 +247,67 @@ def _train_step(
         )
     updates, opt_state = opt.update(grads, opt_state_in, state.params)
     params = optax.apply_updates(state.params, updates)
+    if cfg.kl_target > 0:
+        # KL-adaptive lr: measure the POST-update policy shift on this
+        # batch's taken actions (k3 estimator, E_old[r − 1 − log r] ≥ 0)
+        # and rescale the lr carried in the optimizer state for the NEXT
+        # step. All in-graph: no host sync, fused-mode compatible.
+        logp_pre = metrics.pop("_logp")
+
+        def _measure_kl(operand):
+            params_new, lp_pre = operand
+            T = batch["rewards"].shape[1]
+            obs = batch["obs"]
+            (logits_post, _, _), _ = policy.apply(
+                params_new, obs, batch["carry0"], batch["dones"],
+                method="sequence", mutable=["losses"],
+            )
+            logits_t = {k: v[:, :T] for k, v in logits_post.items()}
+            obs_t = {k: v[:, :T] for k, v in obs.items()}
+            logp_post = D.log_prob(logits_t, obs_t, batch["actions"])
+            d = logp_post - lp_pre
+            valid = batch["valid"].astype(jnp.float32)
+            n_valid = jnp.maximum(valid.sum(), 1.0)
+            return (((jnp.exp(d) - 1.0) - d) * valid).sum() / n_valid
+
+        if cfg.value_warmup_steps:
+            # The frozen-policy window has post-KL ≡ 0 by construction;
+            # skip the measurement forward (~a full policy pass) there.
+            post_kl = jax.lax.cond(
+                state.step >= cfg.value_warmup_steps,
+                _measure_kl,
+                lambda _: jnp.zeros(()),
+                (params, logp_pre),
+            )
+        else:
+            post_kl = _measure_kl((params, logp_pre))
+
+        inj = opt_state[1]
+        lr = inj.hyperparams["learning_rate"]
+        t = cfg.kl_target
+        factor = jnp.where(
+            post_kl > 2.0 * t,
+            cfg.kl_lr_down,
+            jnp.where(post_kl < 0.5 * t, cfg.kl_lr_up, 1.0),
+        )
+        if cfg.value_warmup_steps:
+            # The frozen-policy window measures KL ≡ 0; don't let the
+            # controller ratchet the lr up against a flat signal (the
+            # boundary reset would restore it anyway, but the value head
+            # trains through the warmup at whatever lr this leaves).
+            factor = jnp.where(
+                state.step < cfg.value_warmup_steps, 1.0, factor
+            )
+        new_lr = jnp.clip(
+            lr * factor,
+            cfg.learning_rate * cfg.kl_lr_min_scale,
+            cfg.learning_rate * cfg.kl_lr_max_scale,
+        )
+        hp = dict(inj.hyperparams)
+        hp["learning_rate"] = new_lr
+        opt_state = (opt_state[0], inj._replace(hyperparams=hp))
+        metrics["post_kl"] = post_kl
+        metrics["lr"] = lr
     metrics["grad_norm"] = optax.global_norm(grads)
     new_state = dataclasses.replace(
         state,

@@ -26,13 +26,18 @@ def shape_mismatches(got: Any, want: Any) -> list:
     human-readable ``"(got) != (want)"`` strings for every mismatched leaf.
     Shared by the pipeline restore below and the learner's ``init_from``
     compatibility check so the validation idiom cannot drift."""
-    tree = jax.tree.map(
-        lambda g, w: None
-        if np.shape(g) == np.shape(w)
-        else f"{np.shape(g)} != {np.shape(w)}",
-        got,
-        want,
-    )
+    try:
+        tree = jax.tree.map(
+            lambda g, w: None
+            if np.shape(g) == np.shape(w)
+            else f"{np.shape(g)} != {np.shape(w)}",
+            got,
+            want,
+        )
+    except (ValueError, TypeError) as e:
+        # Different tree STRUCTURE (e.g. a different model core): report it
+        # as one mismatch rather than crashing the comparison.
+        return [f"tree structure differs: {e}"]
     return [
         m
         for m in jax.tree.leaves(tree, is_leaf=lambda x: isinstance(x, str))
@@ -167,6 +172,27 @@ class CheckpointManager:
             args=ocp.args.Composite(config=ocp.args.JsonRestore()),
         )
         return self._decode_config(restored["config"])
+
+    def restore_weights(self) -> Tuple[Any, int]:
+        """Weights-only restore of the latest step: ``(params, step)``.
+
+        Restores the state item WITHOUT a structure template (as-saved
+        layout), so it works across optimizer configurations — e.g. seeding
+        a KL-adaptive-lr run (whose opt_state carries an injected
+        hyperparams leaf) from a plain-Adam source checkpoint. Callers
+        validate the params' shapes against their own model (the learner's
+        ``init_from`` path does); the source's opt_state is ignored
+        entirely, matching init_from's fresh-moments contract.
+        """
+        step = self._latest_step_or_raise()
+        restored = self._mgr.restore(
+            step, args=ocp.args.Composite(state=ocp.args.StandardRestore())
+        )
+        raw = restored["state"]
+        return (
+            jax.tree.map(jax.numpy.asarray, raw["params"]),
+            int(np.asarray(raw["step"])),
+        )
 
     def restore(
         self, config: RunConfig, abstract_state: Optional[TrainState] = None

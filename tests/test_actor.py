@@ -205,3 +205,37 @@ class TestEndToEnd:
             pool.set_params(state.params, int(state.version))
         assert n_train_steps >= 2
         assert pool.version == int(state.version)
+
+
+class TestWindowedStats:
+    def test_mixin_window_deltas(self):
+        """Host-pool windowed stats (the best-checkpoint signal) are deltas
+        between drains, mirroring DeviceActor's device-side window."""
+        from dotaclient_tpu.actor.window_stats import WindowedStatsMixin
+
+        class P(WindowedStatsMixin):
+            def __init__(self):
+                self.episodes_done = 0
+                self.wins = 0
+                self.episode_rewards = []
+
+            def stats(self):
+                return {
+                    "episodes_done": float(self.episodes_done),
+                    **self.windowed_entries(),
+                }
+
+        p = P()
+        assert p.stats()["episodes_recent"] == 0.0
+        p.episodes_done, p.wins = 4, 3
+        p.episode_rewards = [1.0, 1.0, 2.0, 4.0]
+        s = p.drain_stats()
+        assert s["episodes_recent"] == 4.0
+        assert s["win_rate_recent"] == 0.75
+        assert s["ep_reward_recent"] == 2.0
+        p.episodes_done, p.wins = 6, 3
+        p.episode_rewards += [0.0, 0.0]
+        s = p.drain_stats()
+        assert s["episodes_recent"] == 2.0
+        assert s["win_rate_recent"] == 0.0
+        assert s["ep_reward_recent"] == 0.0

@@ -89,6 +89,71 @@ class TestKillAndResume:
                 f"{a_metrics[k]} vs {b_metrics[k]}"
             )
 
+    def test_best_checkpoint_tracks_peak_win_rate(self, tmp_path):
+        """The best/ rotation captures the peak windowed win-rate and does
+        not overwrite it when the metric later falls (the 0.714-peak →
+        0.16-final trajectory in BASELINE.md is the motivating case)."""
+        cfg = dataclasses.replace(
+            small_config(),
+            # tiny noise guard so the short CPU run qualifies
+            checkpoint_best_min_episodes=1,
+            env=dataclasses.replace(
+                small_config().env, n_envs=4, max_dota_time=4.0
+            ),
+        )
+        ckdir = str(tmp_path / "ck")
+        lrn = Learner(cfg, checkpoint_dir=ckdir, seed=3, actor="fused")
+        assert lrn._best_dir is not None
+        # lazy: no stray empty best/ tree before a qualifying save
+        assert lrn.ckpt_best is None
+        lrn.train(6)
+        # Force a qualifying peak through the real code path, then a drop.
+        lrn._best_win = -1.0
+        lrn.device_actor._recent = {
+            "episodes": 10.0, "wins": 9.0, "ep_return_sum": 0.0,
+        }
+        stats = lrn.device_actor.stats()
+        assert stats["win_rate_recent"] == pytest.approx(0.9)
+        lrn._maybe_save_best(stats)          # the real hook
+        assert lrn._best_win == pytest.approx(0.9)
+        best_step_at_peak = lrn.ckpt_best.latest_step()
+        assert best_step_at_peak is not None
+        lrn.train(3)   # real windows are ~0 wins: must NOT displace best
+        assert lrn._best_win == pytest.approx(0.9)
+        assert lrn.ckpt_best.latest_step() == best_step_at_peak
+        # The best checkpoint restores as an init_from source.
+        lrn.ckpt_best.wait()
+        b = Learner(cfg, init_from=str(tmp_path / "ck" / "best"),
+                    actor="fused")
+        assert b._init_from_step == best_step_at_peak
+        # A resumed run must inherit the best-so-far marker (persisted in
+        # best_meta.json) — NOT reset to -1 and let a collapsed window
+        # overwrite the captured peak.
+        resumed = Learner(cfg, checkpoint_dir=ckdir, restore=True,
+                          actor="fused")
+        assert resumed._best_win == pytest.approx(0.9)
+
+    def test_restore_with_toggled_kl_target_fails_loudly(self, tmp_path):
+        """kl_target changes the opt_state layout (injected lr leaf); a
+        --restore across that toggle must raise the translated error, not
+        orbax's raw tree diff."""
+        cfg = small_config()
+        ckdir = str(tmp_path / "ck")
+        lrn = Learner(cfg, checkpoint_dir=ckdir, actor="fused")
+        lrn.train(1)
+        cfg2 = dataclasses.replace(
+            cfg, ppo=dataclasses.replace(cfg.ppo, kl_target=1e-3)
+        )
+        with pytest.raises(ValueError, match="OPTIMIZER layout"):
+            Learner(cfg2, checkpoint_dir=ckdir, restore=True, actor="fused")
+
+    def test_best_checkpoint_disabled_by_zero(self, tmp_path):
+        cfg = dataclasses.replace(
+            small_config(), checkpoint_best_min_episodes=0
+        )
+        lrn = Learner(cfg, checkpoint_dir=str(tmp_path / "ck"), actor="fused")
+        assert lrn.ckpt_best is None and lrn._best_dir is None
+
     def test_restore_without_pipeline_still_works(self, tmp_path):
         """Weights-only checkpoints (no pipeline entry) restore cleanly."""
         cfg = small_config()

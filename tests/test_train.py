@@ -214,6 +214,64 @@ class TestLoss:
         assert counts and all(c == 1 for c in counts), counts
 
 
+class TestKLAdaptiveLR:
+    def _step_fn(self, policy, params, kl_cfg):
+        cfg = dataclasses.replace(CFG, ppo=kl_cfg)
+        mesh = make_mesh(cfg.mesh)
+        return make_train_step(policy, cfg, mesh), init_train_state(
+            params, kl_cfg
+        )
+
+    def test_default_layout_unchanged(self, setup):
+        """kl_target=0 keeps the plain-Adam optimizer state: no injected
+        hyperparams leaf, so existing checkpoints restore unchanged."""
+        policy, params = setup
+        state = init_train_state(params, CFG.ppo)
+        paths = [
+            jax.tree_util.keystr(p)
+            for p, _ in jax.tree_util.tree_flatten_with_path(state.opt_state)[0]
+        ]
+        assert not any("hyperparams" in p for p in paths)
+
+    def test_lr_shrinks_on_kl_overshoot_and_grows_when_under(self, setup):
+        policy, params = setup
+        # Microscopic target: every real update overshoots 2*target.
+        tiny = dataclasses.replace(CFG.ppo, kl_target=1e-9, kl_lr_down=0.5)
+        step, state = self._step_fn(policy, params, tiny)
+        lrs = []
+        for i in range(3):
+            state, m = step(state, random_batch(policy, params, seed=i))
+            assert float(m["post_kl"]) >= 0.0
+            lrs.append(float(m["lr"]))
+        lr0 = CFG.ppo.learning_rate
+        np.testing.assert_allclose(
+            lrs, [lr0, lr0 * 0.5, lr0 * 0.25], rtol=1e-5
+        )
+        # Huge target: always under target/2 -> lr ratchets up by kl_lr_up.
+        huge = dataclasses.replace(CFG.ppo, kl_target=1e3, kl_lr_up=1.5)
+        step, state = self._step_fn(policy, params, huge)
+        lrs = []
+        for i in range(3):
+            state, m = step(state, random_batch(policy, params, seed=i))
+            lrs.append(float(m["lr"]))
+        np.testing.assert_allclose(
+            lrs, [lr0, lr0 * 1.5, lr0 * 2.25], rtol=1e-5
+        )
+
+    def test_lr_clipped_at_min_scale(self, setup):
+        policy, params = setup
+        cfg = dataclasses.replace(
+            CFG.ppo, kl_target=1e-9, kl_lr_down=0.01, kl_lr_min_scale=0.1
+        )
+        step, state = self._step_fn(policy, params, cfg)
+        for i in range(3):
+            state, m = step(state, random_batch(policy, params, seed=i))
+        # After two shrinks the clip floor (0.1 * lr0) is binding.
+        assert float(m["lr"]) == pytest.approx(
+            CFG.ppo.learning_rate * 0.1, rel=1e-5
+        )
+
+
 class TestTrainStep:
     def test_step_runs_and_updates(self, setup):
         policy, params = setup
