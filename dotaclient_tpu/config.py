@@ -115,6 +115,17 @@ class PPOConfig:
     # step size self-tuning instead of a per-run guess. 0 disables
     # (plain constant-lr Adam; optimizer-state layout unchanged).
     kl_target: float = 0.0
+    # Anchor-KL regularizer (AlphaStar's KL-to-supervised-anchor, adapted):
+    # adds anchor_kl_coef · KL(π_θ ‖ π_anchor) to the loss, where π_anchor
+    # is the policy AT LEARNER CONSTRUCTION (after --restore/--init-from —
+    # i.e. the transferred policy in a curriculum run; a mid-run resume
+    # re-anchors at the resumed params). Motivation (BASELINE.md, 5v5
+    # fine-tune): the shaped reward's true optimum is a farming attractor,
+    # and rate limiters (low lr, KL-adaptive lr) only slow the slide into
+    # it — a persistent gradient integrates to the same place. The anchor
+    # term changes the optimum instead: drift from the known-good policy
+    # now costs loss, so improvement must pay for its distance. 0 disables.
+    anchor_kl_coef: float = 0.0
     kl_lr_down: float = 0.7
     kl_lr_up: float = 1.02
     kl_lr_min_scale: float = 0.01
@@ -234,6 +245,14 @@ class RunConfig:
     # ended at 0.16 — the peak policy otherwise rotates out of the periodic
     # checkpoints (BASELINE.md). 0 disables.
     checkpoint_best_min_episodes: int = 50
+    # Fused-mode dispatch batching: lax.scan this many rollout+update
+    # iterations inside the ONE jitted fused program, so each host dispatch
+    # advances K optimizer steps. The host↔device round trip is the fused
+    # path's floor (~100 ms on a tunneled PJRT link — train/fused.py); K>1
+    # amortizes it. Trade-offs: the league opponent draw and all host-side
+    # cadences (logging, eval, snapshots, best-model capture) coarsen to
+    # K-step granularity. Fused mode only; other actors reject K>1.
+    steps_per_dispatch: int = 1
     log_every: int = 10
     seed: int = 0
 
@@ -261,6 +280,9 @@ class RunConfig:
             checkpoint_best_min_episodes=raw.get(
                 "checkpoint_best_min_episodes",
                 cls.checkpoint_best_min_episodes,
+            ),
+            steps_per_dispatch=raw.get(
+                "steps_per_dispatch", cls.steps_per_dispatch
             ),
             **{k: raw[k] for k in ("checkpoint_dir", "checkpoint_every", "log_every", "seed")},
         )

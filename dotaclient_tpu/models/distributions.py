@@ -136,6 +136,35 @@ def _joint_logp(
     )
 
 
+def kl(
+    logits_p: Mapping[str, jnp.ndarray],
+    logits_q: Mapping[str, jnp.ndarray],
+    obs: Mapping[str, jnp.ndarray],
+) -> jnp.ndarray:
+    """Exact KL(P ‖ Q) of the conditional factorization at the same state.
+
+    Mirrors ``entropy``: per-head categorical KLs, with sub-heads weighted
+    by P's probability of selecting their conditioning action type. Both
+    policies see the same observation, so the legality masks (and therefore
+    the supports) coincide — masked entries contribute exp(-1e9)·Δ ≈ 0.
+    """
+    lp = _head_logps(logits_p, obs)
+    lq = _head_logps(logits_q, obs)
+
+    def KLh(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        return jnp.sum(jnp.exp(a) * (a - b), axis=-1)
+
+    p_type = jnp.exp(lp["action_type"])
+    return (
+        KLh(lp["action_type"], lq["action_type"])
+        + p_type[..., A_MOVE]
+        * (KLh(lp["move_x"], lq["move_x"]) + KLh(lp["move_y"], lq["move_y"]))
+        + p_type[..., A_ATTACK] * KLh(lp["target_attack"], lq["target_attack"])
+        + p_type[..., A_CAST]
+        * (KLh(lp["target_cast"], lq["target_cast"]) + KLh(lp["ability"], lq["ability"]))
+    )
+
+
 def entropy(
     logits: Mapping[str, jnp.ndarray], obs: Mapping[str, jnp.ndarray]
 ) -> jnp.ndarray:

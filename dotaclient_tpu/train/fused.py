@@ -25,6 +25,10 @@ Trade-offs vs the buffered path (why both exist):
     re-uses, ratio clipped against the rollout's behavior_logp);
     ``minibatches`` > 1 is unsupported (the chunk lives only inside the
     program, so there is no host-side shuffle point);
+  * ``RunConfig.steps_per_dispatch`` > 1 scans K whole rollout+update
+    iterations per dispatch, amortizing the host↔device round trip K× at
+    the cost of K-step granularity for everything host-side (opponent
+    draws, logging, best-model capture);
   * no cross-process experience — single-host self-play only.
 
 The learner exposes it as ``actor="fused"``.
@@ -42,7 +46,9 @@ from dotaclient_tpu.parallel.mesh import data_sharding, replicated
 from dotaclient_tpu.train.ppo import _train_step, train_state_sharding
 
 
-def make_fused_step(policy: Policy, config: RunConfig, mesh, actor):
+def make_fused_step(
+    policy: Policy, config: RunConfig, mesh, actor, anchor_params=None
+):
     """Compile (state, actor_state, opp_params) → (state', actor_state',
     metrics, stats) against ``mesh``.
 
@@ -54,13 +60,18 @@ def make_fused_step(policy: Policy, config: RunConfig, mesh, actor):
     self-play callers pass the live params (the jitted program has one
     signature for both modes).
     """
+    if (config.ppo.anchor_kl_coef > 0) != (anchor_params is not None):
+        raise ValueError(
+            "anchor_params must be passed exactly when ppo.anchor_kl_coef > 0"
+        )
     ds = data_sharding(mesh, config.mesh)
     repl = replicated(mesh)
     st_sh = train_state_sharding(policy, config, mesh)
 
     n_epochs = config.ppo.epochs_per_batch
+    n_iters = config.steps_per_dispatch
 
-    def fused(state, actor_state, opp_params):
+    def one_iter(state, actor_state, opp_params):
         actor_state, chunk, stats = actor._rollout_impl(
             state.params, actor_state, opp_params
         )
@@ -68,10 +79,14 @@ def make_fused_step(policy: Policy, config: RunConfig, mesh, actor):
             lambda x: jax.lax.with_sharding_constraint(x, ds), chunk
         )
         if n_epochs == 1:
-            new_state, metrics = _train_step(policy, config.ppo, state, chunk)
+            new_state, metrics = _train_step(
+                policy, config.ppo, state, chunk, anchor_params=anchor_params
+            )
         else:
             def epoch(st, _):
-                return _train_step(policy, config.ppo, st, chunk)
+                return _train_step(
+                    policy, config.ppo, st, chunk, anchor_params=anchor_params
+                )
 
             new_state, metric_seq = jax.lax.scan(
                 epoch, state, None, length=n_epochs
@@ -80,6 +95,29 @@ def make_fused_step(policy: Policy, config: RunConfig, mesh, actor):
             # buffered loop's last logged step of a multi-epoch pass
             metrics = jax.tree.map(lambda m: m[-1], metric_seq)
         return new_state, actor_state, metrics, stats
+
+    if n_iters == 1:
+        fused = one_iter
+    else:
+        # Dispatch batching (RunConfig.steps_per_dispatch): scan K whole
+        # rollout+update iterations, so ONE host dispatch advances K
+        # optimizer steps. The opponent is fixed for the dispatch (the
+        # learner rejects league configs whose opponent_hold is shorter
+        # than the dispatch stride); per-chunk
+        # episode stats are additive scalars, summed over the scan so
+        # league attribution sees the dispatch's true totals.
+        def fused(state, actor_state, opp_params):
+            def it(c, _):
+                st, ast = c
+                st, ast, metrics, stats = one_iter(st, ast, opp_params)
+                return (st, ast), (metrics, stats)
+
+            (state, actor_state), (metric_seq, stat_seq) = jax.lax.scan(
+                it, (state, actor_state), None, length=n_iters
+            )
+            metrics = jax.tree.map(lambda m: m[-1], metric_seq)
+            stats = jax.tree.map(lambda s: s.sum(axis=0), stat_seq)
+            return state, actor_state, metrics, stats
 
     # No donation: in self-play the caller passes state.params AS
     # opp_params (one signature for both modes), so donating the state

@@ -101,6 +101,32 @@ class Learner:
                 f"batch_rollouts {config.ppo.batch_rollouts} not "
                 f"divisible by minibatches {config.ppo.minibatches}"
             )
+        if config.steps_per_dispatch < 1:
+            raise ValueError(
+                f"steps_per_dispatch must be >= 1, got "
+                f"{config.steps_per_dispatch}"
+            )
+        if config.steps_per_dispatch > 1 and mode != "fused":
+            raise ValueError(
+                "steps_per_dispatch > 1 batches iterations inside the fused "
+                "program — it has no meaning for the staged actor modes; "
+                "use actor='fused' or leave it at 1"
+            )
+        if (
+            config.league.enabled
+            and config.steps_per_dispatch * config.ppo.steps_per_batch
+            > config.league.opponent_hold
+        ):
+            # opponent redraws can only happen at dispatch boundaries, so a
+            # hold shorter than the stride is silently stretched to it —
+            # PFSP mixing would degrade below its configured cadence.
+            raise ValueError(
+                f"league.opponent_hold ({config.league.opponent_hold}) is "
+                f"shorter than one dispatch stride "
+                f"(steps_per_dispatch × steps_per_batch = "
+                f"{config.steps_per_dispatch * config.ppo.steps_per_batch}) "
+                f"— raise opponent_hold or lower steps_per_dispatch"
+            )
         if mode == "fused" and debug_checkify:
             raise ValueError(
                 "checkify instruments the buffered train step, which fused "
@@ -241,8 +267,18 @@ class Learner:
                             flush=True,
                         )
                         self._best_win = float("inf")
+        # Anchor-KL regularizer (PPOConfig.anchor_kl_coef): the anchor is
+        # the policy AS CONSTRUCTED — after --init-from/--restore — i.e.
+        # the transferred policy in a curriculum fine-tune. Copied: the
+        # train step donates/updates the live params.
+        self.anchor_params = (
+            jax.tree.map(jnp.copy, self.state.params)
+            if config.ppo.anchor_kl_coef > 0
+            else None
+        )
         self.train_step = make_train_step(
-            self.policy, config, self.mesh, debug_checkify=debug_checkify
+            self.policy, config, self.mesh, debug_checkify=debug_checkify,
+            anchor_params=self.anchor_params,
         )
         # Fused mode trains each chunk inside its one program and never
         # stages experience: allocating the HBM ring there would pin
@@ -273,7 +309,8 @@ class Learner:
                 from dotaclient_tpu.train.fused import make_fused_step
 
                 self.fused_step = make_fused_step(
-                    self.policy, config, self.mesh, self.device_actor
+                    self.policy, config, self.mesh, self.device_actor,
+                    anchor_params=self.anchor_params,
                 )
         elif mode == "vec":
             self.pool = VecActorPool(
@@ -588,6 +625,12 @@ class Learner:
         """
         cfg = self.config
         epochs = self._steps_per_batch
+        # host-visible counter stride per loop iteration: fused dispatch
+        # batching advances K×epochs steps per call, so the log/checkpoint
+        # boundary windows must widen with it or boundaries get stepped over
+        stride = epochs * (
+            cfg.steps_per_dispatch if self.fused_step is not None else 1
+        )
         actor_steps = actor_steps_per_iter or cfg.ppo.rollout_len
         t_start = time.time()
         frames_trained = 0
@@ -601,7 +644,7 @@ class Learner:
                 else cfg.ppo.batch_rollouts * cfg.ppo.rollout_len
             )
             step = self._host_step
-            if step % cfg.log_every < epochs:
+            if step % cfg.log_every < stride:
                 # ONE transfer for the whole metrics dict.
                 scalars = {
                     k: float(v) for k, v in jax.device_get(m).items()
@@ -625,9 +668,10 @@ class Learner:
                     scalars["best_win_rate"] = self._best_win
                 self._last_metrics = scalars
                 self.metrics.log(step, scalars)
-            # `< epochs` (not `== 0`): the counter advances in strides of
-            # epochs_per_batch, which may step over exact multiples.
-            if self.ckpt and step % cfg.checkpoint_every < epochs:
+            # `< stride` (not `== 0`): the counter advances in strides of
+            # epochs_per_batch × steps_per_dispatch, which may step over
+            # exact multiples.
+            if self.ckpt and step % cfg.checkpoint_every < stride:
                 # periodic saves are weights-only: the pipeline extras cost a
                 # full buffer+actor device fetch (review finding — on the
                 # tunneled link that stalls the loop for seconds); the forced
@@ -635,10 +679,12 @@ class Learner:
                 self.ckpt.save(self.state, cfg)
 
         if self.fused_step is not None:
-            # Fused mode: rollout + update is ONE program, one dispatch per
-            # optimizer step (train/fused.py). Train batch = the lane set.
+            # Fused mode: rollout + update is ONE program; each dispatch
+            # runs steps_per_dispatch iterations of epochs_per_batch
+            # optimizer steps (train/fused.py). Train batch = the lane set.
             da = self.device_actor
-            frames_per = da.n_lanes * cfg.ppo.rollout_len
+            k_iters = cfg.steps_per_dispatch
+            frames_per = da.n_lanes * cfg.ppo.rollout_len * k_iters
             while steps_done < num_steps:
                 opp_params, opp_idx = self._league_opponent()
                 if opp_params is None:       # self-play / scripted: one
@@ -647,13 +693,13 @@ class Learner:
                     self.state, da.state, opp_params
                 )
                 self._report_league(opp_idx, chunk_stats)
-                # the program ran `epochs` optimizer steps over this chunk —
+                # the program ran `stride` optimizer steps over K chunks —
                 # keep the host mirrors in lockstep with the device counters
-                self._host_step += epochs
-                self._host_version += epochs
+                self._host_step += stride
+                self._host_version += stride
                 da.env_steps += frames_per
-                da.rollouts_shipped += da.n_lanes
-                steps_done += epochs
+                da.rollouts_shipped += da.n_lanes * k_iters
+                steps_done += stride
                 after_step(m, frames=frames_per)
         elif self.device_actor is not None:
             # On-device rollout mode: collect→ingest→train is all dispatch
@@ -804,6 +850,12 @@ def main(argv=None) -> Dict[str, float]:
                    help="with --core transformer: experts per MoE FFN "
                    "layer (0 = dense FFN)")
     p.add_argument(
+        "--steps-per-dispatch", type=int, default=None,
+        help="with --actor fused: scan this many rollout+update iterations "
+        "inside the one compiled program per host dispatch (amortizes the "
+        "host-device round trip; host-side cadences coarsen to this stride)",
+    )
+    p.add_argument(
         "--overlap", action="store_true",
         help="run the actor pool in a background thread (async actor-learner)",
     )
@@ -908,6 +960,10 @@ def main(argv=None) -> Dict[str, float]:
             log_every=1,
         )
         args.steps = min(args.steps, 5)
+    if args.steps_per_dispatch is not None:
+        config = dataclasses.replace(
+            config, steps_per_dispatch=args.steps_per_dispatch
+        )
     env_over = {}
     if args.n_envs is not None:
         env_over["n_envs"] = args.n_envs

@@ -117,6 +117,7 @@ def ppo_loss(
     batch: Batch,
     cfg: PPOConfig,
     step: Any = None,
+    anchor_params: Any = None,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """Clipped-surrogate PPO loss over a batch of rollout chunks.
 
@@ -125,6 +126,10 @@ def ppo_loss(
     entropy bonus, and MoE aux terms are switched off so only the value
     loss trains (see PPOConfig.value_warmup_steps; the matching gradient
     mask in ``_train_step`` keeps the rest of the network bitwise frozen).
+
+    ``anchor_params`` (with ``cfg.anchor_kl_coef > 0``) adds the anchor-KL
+    regularizer: one extra frozen-policy forward over the batch, exact
+    conditional KL(π_θ ‖ π_anchor) per frame (PPOConfig.anchor_kl_coef).
     """
     obs = batch["obs"]
     T = batch["rewards"].shape[1]
@@ -171,18 +176,40 @@ def ppo_loss(
     value_loss = 0.5 * (jnp.square(values_t - returns) * valid).sum() / n_valid
     ent = (D.entropy(logits_t, obs_t) * valid).sum() / n_valid
 
+    anchor_kl = jnp.zeros(())
+    if cfg.anchor_kl_coef > 0 and anchor_params is not None:
+        # Frozen-anchor forward (no gradient: anchor_params is not the
+        # differentiated argument). Same states, same masks — the exact
+        # conditional KL is well-defined per frame.
+        (anchor_logits, _, _), _ = policy.apply(
+            anchor_params, obs, batch["carry0"], batch["dones"],
+            method="sequence", mutable=["losses"],
+        )
+        anchor_logits_t = {k: v[:, :T] for k, v in anchor_logits.items()}
+        anchor_kl = (D.kl(logits_t, anchor_logits_t, obs_t) * valid).sum() / n_valid
+
     if cfg.value_warmup_steps and step is not None:
         policy_on = (step >= cfg.value_warmup_steps).astype(jnp.float32)
     else:
         policy_on = 1.0
     loss = (
         policy_on
-        * (policy_loss - cfg.entropy_coef * ent + cfg.moe_aux_coef * moe_aux)
+        * (
+            policy_loss
+            - cfg.entropy_coef * ent
+            + cfg.moe_aux_coef * moe_aux
+            + cfg.anchor_kl_coef * anchor_kl
+        )
         + cfg.value_coef * value_loss
     )
     metrics = {
         "loss": loss,
         "moe_aux": moe_aux,
+        **(
+            {"anchor_kl": anchor_kl}
+            if cfg.anchor_kl_coef > 0 and anchor_params is not None
+            else {}
+        ),
         # Stashed for _train_step's post-update KL measurement (popped
         # there — never reaches the logger). Only when the KL-adaptive lr
         # is on, to avoid carrying a [B, T] array through aux otherwise.
@@ -201,10 +228,17 @@ def ppo_loss(
 
 
 def _train_step(
-    policy: Policy, cfg: PPOConfig, state: TrainState, batch: Batch
+    policy: Policy,
+    cfg: PPOConfig,
+    state: TrainState,
+    batch: Batch,
+    anchor_params: Any = None,
 ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
     grad_fn = jax.value_and_grad(
-        lambda p: ppo_loss(policy, p, batch, cfg, step=state.step),
+        lambda p: ppo_loss(
+            policy, p, batch, cfg, step=state.step,
+            anchor_params=anchor_params,
+        ),
         has_aux=True,
     )
     (_, metrics), grads = grad_fn(state.params)
@@ -339,6 +373,7 @@ def make_train_step(
     config: RunConfig,
     mesh: Mesh,
     debug_checkify: bool = False,
+    anchor_params: Any = None,
 ):
     """Compile the train step against ``mesh``.
 
@@ -349,7 +384,16 @@ def make_train_step(
     otherwise. XLA inserts the gradient all-reduce (data axis) and the TP
     collectives (model axis) over ICI. The train state is donated —
     params/opt-state update in place in HBM.
+
+    ``anchor_params`` (required iff ``ppo.anchor_kl_coef > 0``) is
+    closure-captured: the anchor is fixed for the compiled step's lifetime,
+    so it rides along as a jit constant (replicated; at policy scale the
+    memory is noise).
     """
+    if (config.ppo.anchor_kl_coef > 0) != (anchor_params is not None):
+        raise ValueError(
+            "anchor_params must be passed exactly when ppo.anchor_kl_coef > 0"
+        )
     from dotaclient_tpu.parallel.mesh import data_sharding as _data_sharding
 
     # (dcn, data) when the mesh is multi-slice, else just (data,): the
@@ -370,7 +414,9 @@ def make_train_step(
         from jax.experimental import checkify
 
         inner = checkify.checkify(
-            lambda state, batch: _train_step(policy, config.ppo, state, batch),
+            lambda state, batch: _train_step(
+                policy, config.ppo, state, batch, anchor_params=anchor_params
+            ),
             errors=checkify.float_checks,
         )
         jitted = jax.jit(inner)
@@ -382,7 +428,9 @@ def make_train_step(
 
         return checked_step
     step_fn = jax.jit(
-        lambda state, batch: _train_step(policy, config.ppo, state, batch),
+        lambda state, batch: _train_step(
+            policy, config.ppo, state, batch, anchor_params=anchor_params
+        ),
         in_shardings=(state_sharding, batch_shardings),
         out_shardings=(state_sharding, metrics_repl),
         donate_argnums=(0,),

@@ -43,6 +43,12 @@ def main() -> None:
     p.add_argument("--kl-target", type=float, default=0.0,
                    help="enable the KL-adaptive lr controller for stage 2 "
                    "instead of a fixed low lr")
+    p.add_argument("--anchor-kl", type=float, default=0.0,
+                   help="stage-2 anchor-KL coefficient: penalize KL from "
+                   "the transferred policy itself (PPOConfig."
+                   "anchor_kl_coef) — the anti-drift lever against the "
+                   "farming attractor BASELINE.md documents (rate limiters "
+                   "only slow the slide; this changes the optimum)")
     p.add_argument("--skip-stage1", action="store_true",
                    help="reuse an existing stage-1 checkpoint")
     args = p.parse_args()
@@ -68,6 +74,8 @@ def main() -> None:
     else:
         ppo = (f"value_warmup_steps=500,entropy_coef=0.001,"
                f"learning_rate={args.lr}")
+    if args.anchor_kl > 0:
+        ppo += f",anchor_kl_coef={args.anchor_kl}"
     run([
         sys.executable, DEMO,
         "--team-size", "5",

@@ -82,7 +82,13 @@ def main() -> None:
                    "(rolling KV-cache carry), the scale-out option")
     p.add_argument("--moe-experts", type=int, default=0,
                    help="with --core transformer: experts per MoE FFN layer")
+    p.add_argument("--steps-per-dispatch", type=int, default=1,
+                   help="with --actor fused: rollout+update iterations "
+                   "scanned inside one dispatch (amortizes the host-device "
+                   "round trip; demo stats/evals coarsen to this stride)")
     args = p.parse_args()
+    if args.steps_per_dispatch > 1 and args.actor != "fused":
+        p.error("--steps-per-dispatch needs --actor fused")
     if args.restore and not args.checkpoint_dir:
         p.error("--restore needs --checkpoint-dir")
     if args.init_from and args.restore:
@@ -162,6 +168,7 @@ def main() -> None:
         # windowed stats the demo prints (TensorBoard cadence only
         # matters when a logdir is given)
         log_every=10_000 if args.logdir else 1_000_000_000,
+        steps_per_dispatch=args.steps_per_dispatch,
         seed=args.seed,
     )
     learner = Learner(config, actor=args.actor, seed=args.seed,

@@ -214,6 +214,100 @@ class TestLoss:
         assert counts and all(c == 1 for c in counts), counts
 
 
+class TestAnchorKL:
+    """PPOConfig.anchor_kl_coef — the AlphaStar-style pull toward a frozen
+    anchor policy (the anti-drift lever for curriculum fine-tunes)."""
+
+    def test_kl_is_exact_and_nonnegative(self, setup):
+        policy, params = setup
+        params2 = init_params(policy, jax.random.PRNGKey(7))
+        batch = random_batch(policy, params, seed=3)
+        T = CFG.ppo.rollout_len
+        obs_t = {k: v[:, :T] for k, v in batch["obs"].items()}
+
+        def logits_of(p):
+            logits, _, _ = policy.apply(
+                p, batch["obs"], batch["carry0"], batch["dones"],
+                method="sequence",
+            )
+            return {k: v[:, :T] for k, v in logits.items()}
+
+        la, lb = logits_of(params), logits_of(params2)
+        self_kl = np.asarray(D.kl(la, la, obs_t))
+        np.testing.assert_allclose(self_kl, 0.0, atol=1e-5)
+        cross = np.asarray(D.kl(la, lb, obs_t))
+        assert (cross > -1e-5).all()
+        assert cross.max() > 1e-4   # distinct params actually differ
+
+    def test_anchor_term_zero_at_anchor_and_positive_away(self, setup):
+        policy, params = setup
+        batch = random_batch(policy, params, seed=4)
+        cfg = dataclasses.replace(CFG.ppo, anchor_kl_coef=0.5)
+        base_loss, base_m = ppo_loss(policy, params, batch, CFG.ppo)
+        loss_at, m_at = ppo_loss(
+            policy, params, batch, cfg, anchor_params=params
+        )
+        np.testing.assert_allclose(
+            float(m_at["anchor_kl"]), 0.0, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            float(loss_at), float(base_loss), rtol=1e-5, atol=1e-6
+        )
+        far = init_params(policy, jax.random.PRNGKey(8))
+        loss_far, m_far = ppo_loss(
+            policy, params, batch, cfg, anchor_params=far
+        )
+        assert float(m_far["anchor_kl"]) > 1e-4
+        np.testing.assert_allclose(
+            float(loss_far),
+            float(base_loss) + 0.5 * float(m_far["anchor_kl"]),
+            rtol=1e-4,
+        )
+
+    def test_train_step_with_anchor_stays_closer(self, setup):
+        """A few steps on the same batches: the anchored run ends closer
+        (in param space) to the anchor than the unanchored run."""
+        policy, params = setup
+        batches = [random_batch(policy, params, seed=s) for s in (5, 6, 7)]
+
+        def run(coef):
+            cfg = dataclasses.replace(
+                CFG,
+                ppo=dataclasses.replace(CFG.ppo, anchor_kl_coef=coef),
+            )
+            mesh = make_mesh(cfg.mesh)
+            step = make_train_step(
+                policy, cfg, mesh,
+                anchor_params=params if coef > 0 else None,
+            )
+            state = init_train_state(params, cfg.ppo)
+            for b in batches:
+                state, m = step(state, b)
+            dist = sum(
+                float(jnp.sum(jnp.square(a - b)))
+                for a, b in zip(
+                    jax.tree.leaves(state.params), jax.tree.leaves(params)
+                )
+            )
+            return dist, m
+
+        d_free, _ = run(0.0)
+        d_anchored, m = run(10.0)
+        assert "anchor_kl" in m
+        assert d_anchored < d_free
+
+    def test_make_train_step_coef_anchor_mismatch_raises(self, setup):
+        policy, params = setup
+        cfg = dataclasses.replace(
+            CFG, ppo=dataclasses.replace(CFG.ppo, anchor_kl_coef=0.1)
+        )
+        mesh = make_mesh(cfg.mesh)
+        with pytest.raises(ValueError, match="anchor_params"):
+            make_train_step(policy, cfg, mesh)
+        with pytest.raises(ValueError, match="anchor_params"):
+            make_train_step(policy, CFG, mesh, anchor_params=params)
+
+
 class TestKLAdaptiveLR:
     def _step_fn(self, policy, params, kl_cfg):
         cfg = dataclasses.replace(CFG, ppo=kl_cfg)
