@@ -132,12 +132,10 @@ def main() -> None:
     for _ in range(3):
         t0 = time.perf_counter()
         out = k8_learner.train(e2e_steps)
+        # frames_trained: dispatch batching overshoots the request in
+        # strides, and epochs/minibatches would double-count via steps×B×T
         k8_fps = max(
-            k8_fps,
-            out["optimizer_steps"]
-            * k8_learner.device_actor.n_lanes
-            * T
-            / (time.perf_counter() - t0),
+            k8_fps, out["frames_trained"] / (time.perf_counter() - t0)
         )
     del k8_learner
 

@@ -23,8 +23,11 @@ Trade-offs vs the buffered path (why both exist):
   * ``epochs_per_batch`` > 1 runs as a ``lax.scan`` of update steps over
     the same chunk INSIDE the program (epoch 2+ are the standard PPO
     re-uses, ratio clipped against the rollout's behavior_logp);
-    ``minibatches`` > 1 is unsupported (the chunk lives only inside the
-    program, so there is no host-side shuffle point);
+    ``minibatches`` > 1 shuffles IN-PROGRAM: each epoch draws a fresh
+    lane permutation (keyed on ``config.seed`` and the optimizer step, so
+    it is deterministic and needs no host shuffle point or carried RNG),
+    splits the chunk into M equal lane groups, and scans an optimizer
+    step per group — the standard PPO minibatch pass, fully fused;
   * ``RunConfig.steps_per_dispatch`` > 1 scans K whole rollout+update
     iterations per dispatch, amortizing the host↔device round trip K× at
     the cost of K-step granularity for everything host-side (opponent
@@ -69,7 +72,61 @@ def make_fused_step(
     st_sh = train_state_sharding(policy, config, mesh)
 
     n_epochs = config.ppo.epochs_per_batch
+    n_mb = max(1, config.ppo.minibatches)
     n_iters = config.steps_per_dispatch
+    L = actor.n_lanes
+    if L % n_mb:
+        raise ValueError(
+            f"fused minibatching splits the {L}-lane chunk along lanes: "
+            f"n_lanes must be divisible by minibatches ({n_mb})"
+        )
+
+    import jax.numpy as jnp
+
+    def update_on_chunk(state, chunk):
+        if n_epochs == 1 and n_mb == 1:
+            return _train_step(
+                policy, config.ppo, state, chunk, anchor_params=anchor_params
+            )
+
+        def epoch(st, _):
+            if n_mb == 1:
+                return _train_step(
+                    policy, config.ppo, st, chunk,
+                    anchor_params=anchor_params,
+                )
+            # In-program shuffle: the permutation is keyed on the run seed
+            # and the optimizer step at epoch entry (strictly increasing,
+            # so every epoch of every iteration draws fresh) — no host
+            # shuffle point, no extra carried RNG state.
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(config.seed), st.step
+            )
+            perm = jax.random.permutation(key, L)
+            mbs = jax.tree.map(
+                lambda x: jnp.take(x, perm, axis=0).reshape(
+                    (n_mb, L // n_mb) + x.shape[1:]
+                ),
+                chunk,
+            )
+
+            def mb_step(s, mb):
+                mb = jax.tree.map(
+                    lambda x: jax.lax.with_sharding_constraint(x, ds), mb
+                )
+                return _train_step(
+                    policy, config.ppo, s, mb, anchor_params=anchor_params
+                )
+
+            st, mseq = jax.lax.scan(mb_step, st, mbs)
+            return st, jax.tree.map(lambda m: m[-1], mseq)
+
+        new_state, metric_seq = jax.lax.scan(
+            epoch, state, None, length=n_epochs
+        )
+        # report the final update (the state reflects it), like the
+        # buffered loop's last logged step of a multi-epoch pass
+        return new_state, jax.tree.map(lambda m: m[-1], metric_seq)
 
     def one_iter(state, actor_state, opp_params):
         actor_state, chunk, stats = actor._rollout_impl(
@@ -78,22 +135,7 @@ def make_fused_step(
         chunk = jax.tree.map(
             lambda x: jax.lax.with_sharding_constraint(x, ds), chunk
         )
-        if n_epochs == 1:
-            new_state, metrics = _train_step(
-                policy, config.ppo, state, chunk, anchor_params=anchor_params
-            )
-        else:
-            def epoch(st, _):
-                return _train_step(
-                    policy, config.ppo, st, chunk, anchor_params=anchor_params
-                )
-
-            new_state, metric_seq = jax.lax.scan(
-                epoch, state, None, length=n_epochs
-            )
-            # report the final epoch (the state reflects it), like the
-            # buffered loop's last logged step of a multi-epoch pass
-            metrics = jax.tree.map(lambda m: m[-1], metric_seq)
+        new_state, metrics = update_on_chunk(state, chunk)
         return new_state, actor_state, metrics, stats
 
     if n_iters == 1:

@@ -86,15 +86,12 @@ class Learner:
         mode = actor or ("vec" if vec else "scalar")
         if mode not in ("device", "fused", "vec", "scalar", "external"):
             raise ValueError(f"unknown actor mode {mode!r}")
-        if mode == "fused" and config.ppo.minibatches != 1:
-            raise ValueError(
-                "fused mode consumes each chunk inside its one program — "
-                "there is no host shuffle point, so minibatches must be 1 "
-                "(epochs_per_batch > 1 is supported: the update scans over "
-                "the chunk in-program)"
-            )
+        # Fused mode shuffles/splits in-program along lanes (train/fused.py
+        # validates n_lanes % minibatches); the buffered paths split the
+        # optimizer batch host-side, so batch_rollouts must divide.
         if (
-            config.ppo.minibatches > 1
+            mode != "fused"
+            and config.ppo.minibatches > 1
             and config.ppo.batch_rollouts % config.ppo.minibatches
         ):
             raise ValueError(
@@ -114,6 +111,7 @@ class Learner:
             )
         if (
             config.league.enabled
+            and mode in ("fused", "device")
             and config.steps_per_dispatch * config.ppo.steps_per_batch
             > config.league.opponent_hold
         ):
@@ -141,17 +139,29 @@ class Learner:
         self.config = config
         self.mesh = make_mesh(config.mesh)
         if config.ppo.minibatches > 1:
-            # each minibatch is itself a data-sharded train batch
+            # each minibatch is itself a data-sharded train batch. In fused
+            # mode the chunk IS the lane set, split along lanes in-program
+            # (train/fused.py); the buffered paths split batch_rollouts.
             from dotaclient_tpu.parallel.mesh import batch_axes
 
             shards = 1
             for a in batch_axes(self.mesh, config.mesh):
                 shards *= self.mesh.shape[a]
-            mb = config.ppo.batch_rollouts // config.ppo.minibatches
-            if mb % shards:
+            if mode == "fused":
+                from dotaclient_tpu.actor.device_rollout import lane_split
+
+                total = config.env.n_envs * len(lane_split(config)[0])
+                what = f"lane count {total}"
+            else:
+                total = config.ppo.batch_rollouts
+                what = f"batch_rollouts {total}"
+            mb = total // config.ppo.minibatches
+            if total % config.ppo.minibatches or mb % shards:
                 raise ValueError(
-                    f"minibatch size {mb} not divisible by the batch shard "
-                    f"count {shards} (minibatches are data-sharded batches)"
+                    f"{what} must split into minibatches "
+                    f"({config.ppo.minibatches}) of a size divisible by the "
+                    f"batch shard count {shards} (minibatches are "
+                    f"data-sharded batches); got minibatch size {mb}"
                 )
         self.policy = make_policy(config.model, config.obs, config.actions)
         params = init_params(self.policy, jax.random.PRNGKey(config.seed))

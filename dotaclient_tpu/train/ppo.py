@@ -181,12 +181,26 @@ def ppo_loss(
         # Frozen-anchor forward (no gradient: anchor_params is not the
         # differentiated argument). Same states, same masks — the exact
         # conditional KL is well-defined per frame.
-        (anchor_logits, _, _), _ = policy.apply(
-            anchor_params, obs, batch["carry0"], batch["dones"],
-            method="sequence", mutable=["losses"],
-        )
-        anchor_logits_t = {k: v[:, :T] for k, v in anchor_logits.items()}
-        anchor_kl = (D.kl(logits_t, anchor_logits_t, obs_t) * valid).sum() / n_valid
+        def _anchor_kl(_):
+            (anchor_logits, _, _), _ = policy.apply(
+                anchor_params, obs, batch["carry0"], batch["dones"],
+                method="sequence", mutable=["losses"],
+            )
+            a_t = {k: v[:, :T] for k, v in anchor_logits.items()}
+            return (D.kl(logits_t, a_t, obs_t) * valid).sum() / n_valid
+
+        if cfg.value_warmup_steps and step is not None:
+            # The warmup window zeroes the whole policy group, so the
+            # anchor forward would be dead compute (~a full extra policy
+            # pass per step) — skip it until the policy trains.
+            anchor_kl = jax.lax.cond(
+                step >= cfg.value_warmup_steps,
+                _anchor_kl,
+                lambda _: jnp.zeros(()),
+                None,
+            )
+        else:
+            anchor_kl = _anchor_kl(None)
 
     if cfg.value_warmup_steps and step is not None:
         policy_on = (step >= cfg.value_warmup_steps).astype(jnp.float32)

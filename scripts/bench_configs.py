@@ -94,7 +94,12 @@ def main() -> None:
     p.add_argument("--core", type=str, default="lstm",
                    choices=("lstm", "transformer"),
                    help="policy core used across all configs")
+    p.add_argument("--steps-per-dispatch", type=int, default=1,
+                   help="with --mode fused: iterations scanned per dispatch "
+                   "(RunConfig.steps_per_dispatch)")
     args = p.parse_args()
+    if args.steps_per_dispatch > 1 and args.mode != "fused":
+        p.error("--steps-per-dispatch needs --mode fused")
 
     from dotaclient_tpu.config import default_config
     from dotaclient_tpu.train.learner import Learner
@@ -104,27 +109,31 @@ def main() -> None:
         base = dataclasses.replace(
             base, model=dataclasses.replace(base.model, core=args.core)
         )
-    B, T = base.ppo.batch_rollouts, base.ppo.rollout_len
     results = []
     for n in (int(s) for s in args.configs.split(",")):
         cfg, desc = build_config(n, base)
-        learner = Learner(cfg, actor=args.mode, seed=args.seed)
-        frames_per_step = (
-            learner.device_actor.n_lanes * T if args.mode == "fused" else B * T
+        cfg = dataclasses.replace(
+            cfg, steps_per_dispatch=args.steps_per_dispatch
         )
+        learner = Learner(cfg, actor=args.mode, seed=args.seed)
         learner.train(20)          # compile + buffer warmup
         fps = 0.0
         for _ in range(3):         # best-of-3: tunneled-TPU service jitter
             t0 = time.perf_counter()
-            learner.train(args.steps)
+            out = learner.train(args.steps)
+            # frames_trained, not steps × a hand-derived frames-per-step:
+            # epochs/minibatches re-use each chunk, and dispatch batching
+            # overshoots the request in strides — the learner's own counter
+            # is the unique-trained-frames truth
             fps = max(
-                fps, args.steps * frames_per_step / (time.perf_counter() - t0)
+                fps, out["frames_trained"] / (time.perf_counter() - t0)
             )
         row = {
             "config": n,
             "desc": desc,
             "mode": args.mode,
             "core": args.core,
+            "steps_per_dispatch": args.steps_per_dispatch,
             "end_to_end_frames_per_sec": round(fps, 1),
             "n_envs": cfg.env.n_envs,
             "team_size": cfg.env.team_size,

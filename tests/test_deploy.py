@@ -1,0 +1,110 @@
+"""Deployment-manifest drift tests (SURVEY.md §1 row 7).
+
+The k8s manifests embed CLI invocations of the learner and actor
+entrypoints. Nothing else executes them in CI, so a renamed/removed flag
+would ship a manifest that crash-loops at deploy time. These tests pin:
+every ``--flag`` a manifest passes exists in the target module's argparse
+surface, the ``-m`` module paths are importable, and the service/selector
+plumbing that the actor fleet depends on stays consistent.
+"""
+
+import importlib.util
+import os
+import re
+
+import yaml
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+K8S = os.path.join(ROOT, "deploy", "k8s")
+
+
+def load_docs(name):
+    with open(os.path.join(K8S, name)) as f:
+        return [d for d in yaml.safe_load_all(f) if d is not None]
+
+
+def container_specs(doc):
+    if doc.get("kind") != "Deployment":
+        return []
+    return doc["spec"]["template"]["spec"]["containers"]
+
+
+def split_module_and_flags(args):
+    """Parse a ``[-m, module, --flag=value, ...]`` container args list."""
+    assert args[0] == "-m", args
+    module = args[1]
+    flags = [a.split("=", 1)[0] for a in args[2:] if a.startswith("--")]
+    return module, flags
+
+
+def argparse_flags_of(module_rel_path):
+    src = open(os.path.join(ROOT, module_rel_path)).read()
+    return set(re.findall(r'"(--[a-z0-9-]+)"', src))
+
+
+CLI_SOURCES = {
+    "dotaclient_tpu.train.learner": "dotaclient_tpu/train/learner.py",
+    "dotaclient_tpu.actor": "dotaclient_tpu/actor/__main__.py",
+}
+
+
+class TestManifests:
+    def test_yaml_parses(self):
+        for name in os.listdir(K8S):
+            assert load_docs(name), name
+
+    def test_manifest_flags_exist_in_cli(self):
+        checked = 0
+        for name in os.listdir(K8S):
+            for doc in load_docs(name):
+                for c in container_specs(doc):
+                    if "args" not in c:
+                        continue
+                    module, flags = split_module_and_flags(c["args"])
+                    assert module in CLI_SOURCES, (
+                        f"{name}: unknown entry module {module}"
+                    )
+                    known = argparse_flags_of(CLI_SOURCES[module])
+                    for fl in flags:
+                        assert fl in known, (
+                            f"{name}: {module} does not accept {fl}"
+                        )
+                        checked += 1
+        assert checked >= 8  # both deployments actually carry flags
+
+    def test_entry_modules_importable(self):
+        for module in CLI_SOURCES:
+            spec = importlib.util.find_spec(module)
+            assert spec is not None, module
+
+    def test_actor_connects_to_learner_service(self):
+        """The actor fleet's --connect target must match the learner
+        Service name and port."""
+        services = {
+            d["metadata"]["name"]: d
+            for d in load_docs("learner.yaml")
+            if d.get("kind") == "Service"
+        }
+        (actor,) = [
+            c
+            for d in load_docs("actors.yaml")
+            for c in container_specs(d)
+        ]
+        connect = [a for a in actor["args"] if a.startswith("--connect=")]
+        assert connect, "actor manifest must pass --connect"
+        host, port = connect[0].split("=", 1)[1].rsplit(":", 1)
+        assert host in services, f"no Service named {host}"
+        ports = [p["port"] for p in services[host]["spec"]["ports"]]
+        assert int(port) in ports, (host, port, ports)
+
+    def test_actor_pods_get_unique_seed_source(self):
+        """Replicated actors derive their rollout seed from POD_NAME — the
+        manifest must inject it or every replica streams identical
+        experience (actor/__main__.py seed derivation)."""
+        (actor,) = [
+            c
+            for d in load_docs("actors.yaml")
+            for c in container_specs(d)
+        ]
+        env_names = {e["name"] for e in actor.get("env", [])}
+        assert "POD_NAME" in env_names

@@ -108,14 +108,88 @@ class TestFusedStep:
         # each fused call contributes ONE chunk of unique frames
         assert out["frames_trained"] == 2 * learner.device_actor.n_lanes * 4
 
-    def test_fused_rejects_minibatches(self):
+    def test_fused_minibatches_shuffle_in_program(self):
+        """minibatches > 1 in fused mode: each epoch permutes the lanes
+        (keyed on seed + step) and scans an optimizer step per group —
+        verified against a hand-built reference of the same math."""
+        from dotaclient_tpu.actor.device_rollout import DeviceActor
+        from dotaclient_tpu.models import init_params, make_policy
+        from dotaclient_tpu.parallel import make_mesh
+        from dotaclient_tpu.train.fused import make_fused_step
+        from dotaclient_tpu.train.ppo import _train_step, init_train_state
+
+        M = 2
+        cfg = tiny_cfg()
+        cfg = dataclasses.replace(
+            cfg, ppo=dataclasses.replace(cfg.ppo, minibatches=M)
+        )
+        mesh = make_mesh(cfg.mesh, devices=jax.devices()[:1])
+        policy = make_policy(cfg.model, cfg.obs, cfg.actions)
+        params = init_params(policy, jax.random.PRNGKey(0))
+        actor = DeviceActor(cfg, policy, seed=3)
+        actor_state0 = jax.tree.map(jnp.copy, actor.state)
+        L = actor.n_lanes
+
+        # reference: collect, permute with the same key derivation, M
+        # sequential optimizer steps on the lane groups
+        ref_state = init_train_state(params, cfg.ppo)
+        _, chunk, _ = jax.jit(actor._rollout_impl)(
+            ref_state.params, actor_state0, ref_state.params
+        )
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(cfg.seed), ref_state.step
+        )
+        perm = jax.random.permutation(key, L)
+        shuf = jax.tree.map(lambda x: jnp.take(x, perm, axis=0), chunk)
+        step_jit = jax.jit(
+            lambda s, b: _train_step(policy, cfg.ppo, s, b)
+        )
+        for m in range(M):
+            mb = jax.tree.map(
+                lambda x: x[m * (L // M):(m + 1) * (L // M)], shuf
+            )
+            ref_state, _ = step_jit(ref_state, mb)
+
+        fused = make_fused_step(policy, cfg, mesh, actor)
+        got_state, _, metrics, _ = fused(
+            init_train_state(params, cfg.ppo),
+            jax.tree.map(jnp.copy, actor_state0),
+            params,
+        )
+        assert int(got_state.step) == M
+        for got, want in zip(
+            jax.tree.leaves(got_state.params), jax.tree.leaves(ref_state.params)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-6
+            )
+        assert np.isfinite(float(np.asarray(metrics["loss"])))
+
+    def test_learner_fused_minibatch_accounting(self):
         from dotaclient_tpu.train.learner import Learner
 
-        cfg = tiny_cfg()
+        # 32 lanes: each of the 2 minibatches (16 lanes) must itself split
+        # over the forced 8-device data axis
+        cfg = tiny_cfg(n_envs=32)
         cfg = dataclasses.replace(
             cfg, ppo=dataclasses.replace(cfg.ppo, minibatches=2)
         )
-        with pytest.raises(ValueError, match="minibatches"):
+        learner = Learner(cfg, actor="fused", seed=1)
+        out = learner.train(4)    # 2 dispatches × 2 minibatch steps
+        assert out["optimizer_steps"] == 4.0
+        assert int(learner.state.step) == 4
+        assert int(learner.state.version) == learner._host_version
+        # each dispatch contributes ONE chunk of unique frames
+        assert out["frames_trained"] == 2 * learner.device_actor.n_lanes * 4
+
+    def test_fused_minibatches_must_divide_lanes(self):
+        from dotaclient_tpu.train.learner import Learner
+
+        cfg = tiny_cfg(n_envs=8)
+        cfg = dataclasses.replace(
+            cfg, ppo=dataclasses.replace(cfg.ppo, minibatches=3)
+        )
+        with pytest.raises(ValueError, match="divisible"):
             Learner(cfg, actor="fused")
 
     def test_steps_per_dispatch_scans_whole_iterations(self):
