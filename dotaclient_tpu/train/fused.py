@@ -42,6 +42,7 @@ from __future__ import annotations
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 
 from dotaclient_tpu.config import RunConfig
 from dotaclient_tpu.models.policy import Policy
@@ -80,8 +81,6 @@ def make_fused_step(
             f"fused minibatching splits the {L}-lane chunk along lanes: "
             f"n_lanes must be divisible by minibatches ({n_mb})"
         )
-
-    import jax.numpy as jnp
 
     def update_on_chunk(state, chunk):
         if n_epochs == 1 and n_mb == 1:

@@ -12,7 +12,10 @@ import importlib.util
 import os
 import re
 
-import yaml
+import pytest
+
+yaml = pytest.importorskip("yaml")   # PyYAML: baked into this image, but
+                                     # the suite must not die without it
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 K8S = os.path.join(ROOT, "deploy", "k8s")
