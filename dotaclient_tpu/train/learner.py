@@ -110,7 +110,9 @@ class Learner:
                 "use actor='fused' or leave it at 1"
             )
         if (
-            config.league.enabled
+            # the pool itself is gated on env.opponent (below), so the
+            # guard must be too — league.enabled alone can be stale
+            (config.league.enabled or config.env.opponent == "league")
             and mode in ("fused", "device")
             and config.steps_per_dispatch * config.ppo.steps_per_batch
             > config.league.opponent_hold
@@ -860,6 +862,21 @@ def main(argv=None) -> Dict[str, float]:
                    help="with --core transformer: experts per MoE FFN "
                    "layer (0 = dense FFN)")
     p.add_argument(
+        "--ppo", type=str, default=None, metavar="K=V,...",
+        help="comma-separated PPOConfig overrides, e.g. "
+        "'learning_rate=1e-4,entropy_coef=0.001,anchor_kl_coef=0.05'",
+    )
+    p.add_argument(
+        "--reward", type=str, default=None, metavar="K=V,...",
+        help="comma-separated RewardConfig overrides, e.g. "
+        "'win=25,tower_damage=20'",
+    )
+    p.add_argument(
+        "--league", type=str, default=None, metavar="K=V,...",
+        help="comma-separated LeagueConfig overrides (with --opponent "
+        "league), e.g. 'anchor_prob=0.25,snapshot_every=200'",
+    )
+    p.add_argument(
         "--steps-per-dispatch", type=int, default=None,
         help="with --actor fused: scan this many rollout+update iterations "
         "inside the one compiled program per host dispatch (amortizes the "
@@ -973,6 +990,36 @@ def main(argv=None) -> Dict[str, float]:
     if args.steps_per_dispatch is not None:
         config = dataclasses.replace(
             config, steps_per_dispatch=args.steps_per_dispatch
+        )
+    from dotaclient_tpu.config import LeagueConfig, PPOConfig, RewardConfig
+    from dotaclient_tpu.utils.overrides import parse_dataclass_overrides
+
+    if args.league and args.opponent != "league":
+        p.error("--league overrides need --opponent league")
+    league_over_fields = set()
+    for flag, text, sub, cls in (
+        ("--ppo", args.ppo, "ppo", PPOConfig),
+        ("--reward", args.reward, "reward", RewardConfig),
+        ("--league", args.league, "league", LeagueConfig),
+    ):
+        if not text:
+            continue
+        try:
+            over = parse_dataclass_overrides(cls, text, flag)
+        except ValueError as e:
+            p.error(str(e))
+        if sub == "league":
+            league_over_fields = set(over)
+        config = dataclasses.replace(
+            config, **{sub: dataclasses.replace(getattr(config, sub), **over)}
+        )
+    if args.opponent == "league" and "enabled" not in league_over_fields:
+        # mirror the demo: a league run DEFAULTS to a live league config
+        # (so the enabled-gated validations apply and the checkpointed
+        # config says what ran), but an explicit enabled=false override
+        # is respected
+        config = dataclasses.replace(
+            config, league=dataclasses.replace(config.league, enabled=True)
         )
     env_over = {}
     if args.n_envs is not None:

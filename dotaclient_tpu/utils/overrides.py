@@ -1,0 +1,65 @@
+"""Comma-separated dataclass-field overrides for CLI flags.
+
+One shared parser behind every ``--ppo a=1,b=2``-style flag (the demo's
+fine-tune knobs and the learner CLI's cluster parity), so field-name
+validation, type casting, and enum checks cannot drift between
+entrypoints. Raises ``ValueError`` — callers map it to their own error
+surface (``argparse.error`` in the CLIs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+
+def parse_dataclass_overrides(cls: Any, text: str, flag: str) -> Dict[str, Any]:
+    """Parse ``"k=v,k2=v2"`` into a dict of typed values for ``cls`` fields.
+
+    Casting follows the field's declared type: str fields take the raw
+    string, int fields ``int()``, bool fields accept true/false/1/0,
+    everything else ``float()``. Unknown names and uncastable values
+    raise ``ValueError`` mentioning ``flag``.
+    """
+    fields = {f.name: f.type for f in dataclasses.fields(cls)}
+    out: Dict[str, Any] = {}
+    for kv in text.split(","):
+        k, _, v = kv.partition("=")
+        k = k.strip()
+        if k not in fields:
+            raise ValueError(
+                f"{flag}: unknown field {k!r} (one of {sorted(fields)})"
+            )
+        if fields[k] in (str, "str"):
+            caster: Any = str
+        elif fields[k] in (bool, "bool"):
+            def caster(s: str) -> bool:   # noqa: E731 — named for errors
+                low = s.lower()
+                if low in ("true", "1"):
+                    return True
+                if low in ("false", "0"):
+                    return False
+                raise ValueError(s)
+
+            caster.__name__ = "bool"
+        elif fields[k] in (int, "int"):
+            caster = int
+        else:
+            caster = float
+        try:
+            out[k] = caster(v.strip())
+        except ValueError:
+            raise ValueError(
+                f"{flag}: bad {caster.__name__} for {k!r}: {v!r}"
+            ) from None
+    # Enum-like string fields die at parse time, not minutes later at the
+    # first train-step trace (after initial evals burned TPU wall-clock).
+    if "adv_norm" in fields and out.get("adv_norm") is not None:
+        from dotaclient_tpu.config import ADV_NORM_MODES
+
+        if out["adv_norm"] not in ADV_NORM_MODES:
+            raise ValueError(
+                f"{flag}: adv_norm must be one of {ADV_NORM_MODES}, "
+                f"got {out['adv_norm']!r}"
+            )
+    return out

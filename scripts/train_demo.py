@@ -44,11 +44,21 @@ def main() -> None:
                    help="comma-separated hero ids (default: single-hero "
                    "at team size 1, {1,2,3} otherwise)")
     p.add_argument("--opponent", type=str, default="scripted_easy",
-                   choices=("scripted_easy", "scripted_hard", "selfplay"),
+                   choices=("scripted_easy", "scripted_hard", "selfplay",
+                            "league"),
                    help="training opponent (evals always measure both "
                    "scripted bots); fine-tune stages should train against "
                    "an opponent the policy does NOT already beat — a "
-                   "near-optimal matchup has ~zero advantage signal")
+                   "near-optimal matchup has ~zero advantage signal; "
+                   "'league' trains vs frozen snapshots of past selves "
+                   "(LeagueConfig; tune with --league)")
+    p.add_argument("--league", type=str, default=None,
+                   help="comma-separated LeagueConfig overrides with "
+                   "--opponent league, e.g. 'anchor_prob=0.25,"
+                   "snapshot_every=200,pool_size=8' — anchor_prob pins "
+                   "that fraction of games to a scripted bot (AlphaStar-"
+                   "style anchors; keeps push behavior in a self-play "
+                   "meta)")
     p.add_argument("--ppo", type=str, default=None,
                    help="comma-separated PPOConfig overrides, e.g. "
                    "'entropy_coef=0.001,learning_rate=1e-4' — fine-tune "
@@ -95,7 +105,7 @@ def main() -> None:
         p.error("--init-from and --restore are mutually exclusive")
 
     from dotaclient_tpu.config import (
-        ADV_NORM_MODES, PPOConfig, RewardConfig, default_config,
+        LeagueConfig, PPOConfig, RewardConfig, default_config,
     )
     from dotaclient_tpu.league import evaluate
     from dotaclient_tpu.train.learner import Learner
@@ -114,32 +124,12 @@ def main() -> None:
     else:
         hero_pool = (1,) if args.team_size == 1 else (1, 2, 3)
     def parse_overrides(flag: str, text: str, cls) -> dict:
-        fields = {f.name: f.type for f in dataclasses.fields(cls)}
-        out = {}
-        for kv in text.split(","):
-            k, _, v = kv.partition("=")
-            k = k.strip()
-            if k not in fields:
-                p.error(f"{flag}: unknown field {k!r} (one of {sorted(fields)})")
-            if fields[k] in (str, "str"):
-                caster = str
-            elif fields[k] in (int, "int"):
-                caster = int
-            else:
-                caster = float
-            try:
-                out[k] = caster(v.strip())
-            except ValueError:
-                p.error(f"{flag}: bad {caster.__name__} for {k!r}: {v!r}")
-        # Validate enum-like string fields at parse time: a typo must die
-        # here, not minutes later at the first train-step trace (after both
-        # initial evals have burned TPU wall-clock).
-        if out.get("adv_norm") is not None and out["adv_norm"] not in ADV_NORM_MODES:
-            p.error(
-                f"{flag}: adv_norm must be one of {ADV_NORM_MODES}, "
-                f"got {out['adv_norm']!r}"
-            )
-        return out
+        from dotaclient_tpu.utils.overrides import parse_dataclass_overrides
+
+        try:
+            return parse_dataclass_overrides(cls, text, flag)
+        except ValueError as e:
+            p.error(str(e))
 
     reward_over = (
         parse_overrides("--reward", args.reward, RewardConfig)
@@ -148,11 +138,20 @@ def main() -> None:
     ppo_over = (
         parse_overrides("--ppo", args.ppo, PPOConfig) if args.ppo else {}
     )
+    if args.league and args.opponent != "league":
+        p.error("--league overrides need --opponent league")
+    league_over = (
+        parse_overrides("--league", args.league, LeagueConfig)
+        if args.league else {}
+    )
+    if args.opponent == "league":
+        league_over.setdefault("enabled", True)
     config = default_config()
     config = dataclasses.replace(
         config,
         reward=dataclasses.replace(config.reward, **reward_over),
         ppo=dataclasses.replace(config.ppo, **ppo_over),
+        league=dataclasses.replace(config.league, **league_over),
         model=dataclasses.replace(
             config.model, core=args.core, moe_experts=args.moe_experts
         ),

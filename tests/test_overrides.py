@@ -1,0 +1,57 @@
+"""Shared CLI override parser (utils/overrides.py) — both entrypoints'
+``--ppo/--reward/--league`` flags ride on it."""
+
+import pytest
+
+from dotaclient_tpu.config import LeagueConfig, PPOConfig, RewardConfig
+from dotaclient_tpu.utils.overrides import parse_dataclass_overrides
+
+
+class TestParseOverrides:
+    def test_types_follow_field_declarations(self):
+        out = parse_dataclass_overrides(
+            PPOConfig,
+            "learning_rate=1e-5,rollout_len=8,adv_norm=none,anchor_kl_coef=0.05",
+            "--ppo",
+        )
+        assert out == {
+            "learning_rate": 1e-5,
+            "rollout_len": 8,
+            "adv_norm": "none",
+            "anchor_kl_coef": 0.05,
+        }
+        assert isinstance(out["rollout_len"], int)
+
+    def test_reward_and_league_fields(self):
+        assert parse_dataclass_overrides(RewardConfig, "win=25", "--reward") == {
+            "win": 25.0
+        }
+        out = parse_dataclass_overrides(
+            LeagueConfig, "anchor_prob=0.25,snapshot_every=200", "--league"
+        )
+        assert out == {"anchor_prob": 0.25, "snapshot_every": 200}
+
+    def test_bool_fields_accept_words_and_digits(self):
+        for text, want in (
+            ("enabled=true", True),
+            ("enabled=1", True),
+            ("enabled=false", False),
+            ("enabled=0", False),
+        ):
+            out = parse_dataclass_overrides(LeagueConfig, text, "--league")
+            assert out == {"enabled": want}
+            assert isinstance(out["enabled"], bool)
+        with pytest.raises(ValueError, match="bad bool"):
+            parse_dataclass_overrides(LeagueConfig, "enabled=maybe", "--league")
+
+    def test_unknown_field_raises_with_flag_name(self):
+        with pytest.raises(ValueError, match=r"--ppo.*bogus"):
+            parse_dataclass_overrides(PPOConfig, "bogus=1", "--ppo")
+
+    def test_bad_value_raises(self):
+        with pytest.raises(ValueError, match="bad int"):
+            parse_dataclass_overrides(PPOConfig, "rollout_len=abc", "--ppo")
+
+    def test_adv_norm_enum_checked_at_parse_time(self):
+        with pytest.raises(ValueError, match="adv_norm"):
+            parse_dataclass_overrides(PPOConfig, "adv_norm=bogus", "--ppo")
