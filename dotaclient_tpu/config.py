@@ -68,6 +68,9 @@ class ModelConfig:
 # runtime check in train.ppo and any CLI-level validation.
 ADV_NORM_MODES = ("batch", "none")
 
+# Valid PPOConfig.advantage estimators.
+ADVANTAGE_MODES = ("gae", "vtrace")
+
 
 @dataclasses.dataclass(frozen=True)
 class PPOConfig:
@@ -94,6 +97,16 @@ class PPOConfig:
     # adv_norm="none" centers but never rescales.
     adv_norm: str = "batch"      # one of ADV_NORM_MODES
     adv_norm_floor: float = 0.0
+    # Advantage estimator. "gae" (reference parity) assumes on-policy
+    # batches; "vtrace" (IMPALA) reweights every step by the clipped
+    # importance ratio min(ρ̄, π/μ) so STALE rollouts from async actors
+    # contribute bias-corrected targets instead of being merely tolerated
+    # by the PPO clip — the estimator for the external/overlap topology
+    # at high staleness. gae_lambda is unused under vtrace (its trace
+    # cutting comes from the c̄-clipped ratios).
+    advantage: str = "gae"       # one of ADVANTAGE_MODES
+    vtrace_rho_clip: float = 1.0
+    vtrace_c_clip: float = 1.0
     # Critic-only warmup: for the first N optimizer steps, train ONLY the
     # value head (policy surrogate + entropy off; all non-value-head grads
     # masked to zero, so the behavior policy is bitwise frozen). The

@@ -52,6 +52,94 @@ def gae(
     return advantages, returns
 
 
+def vtrace(
+    rewards: jnp.ndarray,        # f32 [B, T]
+    values: jnp.ndarray,         # f32 [B, T+1] — includes bootstrap value
+    dones: jnp.ndarray,          # bool/f32 [B, T]
+    behavior_logp: jnp.ndarray,  # f32 [B, T] — μ(a|s) at collection time
+    target_logp: jnp.ndarray,    # f32 [B, T] — π(a|s) under current params
+    gamma: float,
+    rho_clip: float = 1.0,
+    c_clip: float = 1.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """V-trace targets and policy-gradient advantages (IMPALA, Espeholt et
+    al. 2018 — the off-policy correction IMPACT [P:9] builds on).
+
+    Where GAE assumes the batch is on-policy, V-trace reweights each step
+    by the clipped importance ratio ρ_t = min(ρ̄, π/μ), so stale rollouts
+    from async actors contribute a bias-corrected value target instead of
+    being merely tolerated by the PPO clip. Returns ``(pg_advantages,
+    vs)``: feed ``pg_advantages`` to the surrogate and regress the value
+    head onto ``vs``. On-policy (π ≡ μ) with ρ̄ = c̄ ≥ 1 this reduces
+    exactly to GAE(λ=1) — pinned by a test.
+
+    ``dones`` cuts the recursion exactly like :func:`gae`; importance
+    weights are consumed as constants (callers pass stop-gradient logps).
+    """
+    not_done = 1.0 - dones.astype(jnp.float32)
+    rho = jnp.minimum(jnp.exp(target_logp - behavior_logp), rho_clip)
+    c = jnp.minimum(jnp.exp(target_logp - behavior_logp), c_clip)
+    deltas = rho * (
+        rewards + gamma * not_done * values[:, 1:] - values[:, :-1]
+    )
+
+    def backward(carry, xs):
+        delta_t, c_t, nd_t = xs
+        carry = delta_t + gamma * c_t * nd_t * carry
+        return carry, carry
+
+    _, corr_rev = jax.lax.scan(
+        backward,
+        jnp.zeros_like(deltas[:, 0]),
+        (deltas.T, c.T, not_done.T),
+        reverse=True,
+    )
+    corr = corr_rev.T                       # vs_t − V(s_t)
+    vs = corr + values[:, :-1]
+    # vs_{t+1}: the next step's target, bootstrap V(s_T) at the chunk end.
+    vs_next = jnp.concatenate([vs[:, 1:], values[:, -1:]], axis=1)
+    pg_adv = rho * (
+        rewards + gamma * not_done * vs_next - values[:, :-1]
+    )
+    return pg_adv, vs
+
+
+def vtrace_reference(
+    rewards, values, dones, behavior_logp, target_logp, gamma,
+    rho_clip=1.0, c_clip=1.0,
+):
+    """Plain NumPy reference implementation (test oracle)."""
+    import numpy as np
+
+    rewards, values, dones, blp, tlp = map(
+        np.asarray, (rewards, values, dones, behavior_logp, target_logp)
+    )
+    B, T = rewards.shape
+    vs = np.zeros((B, T), dtype=np.float64)
+    for b in range(B):
+        acc = 0.0
+        for t in reversed(range(T)):
+            nd = 1.0 - float(dones[b, t])
+            w = float(np.exp(tlp[b, t] - blp[b, t]))
+            rho = min(rho_clip, w)
+            cc = min(c_clip, w)
+            delta = rho * (
+                rewards[b, t] + gamma * nd * values[b, t + 1] - values[b, t]
+            )
+            acc = delta + gamma * cc * nd * acc
+            vs[b, t] = values[b, t] + acc
+    pg = np.zeros((B, T), dtype=np.float64)
+    for b in range(B):
+        for t in range(T):
+            nd = 1.0 - float(dones[b, t])
+            rho = min(rho_clip, float(np.exp(tlp[b, t] - blp[b, t])))
+            nxt = vs[b, t + 1] if t + 1 < T else values[b, T]
+            pg[b, t] = rho * (
+                rewards[b, t] + gamma * nd * nxt - values[b, t]
+            )
+    return pg.astype(np.float32), vs.astype(np.float32)
+
+
 def gae_reference(rewards, values, dones, gamma, lam):
     """Plain NumPy reference implementation (test oracle, SURVEY.md §4)."""
     import numpy as np

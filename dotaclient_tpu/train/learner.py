@@ -996,7 +996,7 @@ def main(argv=None) -> Dict[str, float]:
 
     if args.league and args.opponent != "league":
         p.error("--league overrides need --opponent league")
-    league_over_fields = set()
+    parsed: Dict[str, dict] = {}
     for flag, text, sub, cls in (
         ("--ppo", args.ppo, "ppo", PPOConfig),
         ("--reward", args.reward, "reward", RewardConfig),
@@ -1005,21 +1005,18 @@ def main(argv=None) -> Dict[str, float]:
         if not text:
             continue
         try:
-            over = parse_dataclass_overrides(cls, text, flag)
+            parsed[sub] = parse_dataclass_overrides(cls, text, flag)
         except ValueError as e:
             p.error(str(e))
-        if sub == "league":
-            league_over_fields = set(over)
+    if args.opponent == "league":
+        # same glue as the demo: a league run DEFAULTS to a live league
+        # config (so the enabled-gated validations apply and the
+        # checkpointed config says what ran); an explicit enabled=false
+        # override is respected
+        parsed.setdefault("league", {}).setdefault("enabled", True)
+    for sub, over in parsed.items():
         config = dataclasses.replace(
             config, **{sub: dataclasses.replace(getattr(config, sub), **over)}
-        )
-    if args.opponent == "league" and "enabled" not in league_over_fields:
-        # mirror the demo: a league run DEFAULTS to a live league config
-        # (so the enabled-gated validations apply and the checkpointed
-        # config says what ran), but an explicit enabled=false override
-        # is respected
-        config = dataclasses.replace(
-            config, league=dataclasses.replace(config.league, enabled=True)
         )
     env_over = {}
     if args.n_envs is not None:

@@ -25,10 +25,12 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dotaclient_tpu.config import ADV_NORM_MODES, PPOConfig, RunConfig
+from dotaclient_tpu.config import (
+    ADV_NORM_MODES, ADVANTAGE_MODES, PPOConfig, RunConfig,
+)
 from dotaclient_tpu.models import distributions as D
 from dotaclient_tpu.models.policy import Policy
-from dotaclient_tpu.train.gae import gae
+from dotaclient_tpu.train.gae import gae, vtrace
 
 
 @flax.struct.dataclass
@@ -146,13 +148,34 @@ def ppo_loss(
     obs_t = {k: v[:, :T] for k, v in obs.items()}
     values_t = values[:, :T]
 
-    adv, returns = gae(
-        batch["rewards"],
-        jax.lax.stop_gradient(values),
-        batch["dones"],
-        cfg.gamma,
-        cfg.gae_lambda,
-    )
+    logp = D.log_prob(logits_t, obs_t, batch["actions"])
+
+    if cfg.advantage == "gae":
+        adv, returns = gae(
+            batch["rewards"],
+            jax.lax.stop_gradient(values),
+            batch["dones"],
+            cfg.gamma,
+            cfg.gae_lambda,
+        )
+    elif cfg.advantage == "vtrace":
+        # Importance weights are constants to the optimizer (stop-grad on
+        # the target logp): the surrogate's gradient flows through the
+        # ratio below, not through the advantage estimate.
+        adv, returns = vtrace(
+            batch["rewards"],
+            jax.lax.stop_gradient(values),
+            batch["dones"],
+            batch["behavior_logp"],
+            jax.lax.stop_gradient(logp),
+            cfg.gamma,
+            cfg.vtrace_rho_clip,
+            cfg.vtrace_c_clip,
+        )
+    else:
+        raise ValueError(
+            f"unknown advantage {cfg.advantage!r} (one of {ADVANTAGE_MODES})"
+        )
     # Advantage normalization over the (valid) batch. Always centered;
     # rescaled per cfg.adv_norm — the floor keeps near-zero advantage
     # batches from being blown up to unit scale (cfg comment, BASELINE.md
@@ -167,8 +190,6 @@ def ppo_loss(
         raise ValueError(
             f"unknown adv_norm {cfg.adv_norm!r} (one of {ADV_NORM_MODES})"
         )
-
-    logp = D.log_prob(logits_t, obs_t, batch["actions"])
     ratio = jnp.exp(logp - batch["behavior_logp"])
     clipped = jnp.clip(ratio, 1.0 - cfg.clip_eps, 1.0 + cfg.clip_eps)
     policy_loss = -(jnp.minimum(ratio * adv, clipped * adv) * valid).sum() / n_valid

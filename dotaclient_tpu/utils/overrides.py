@@ -62,4 +62,12 @@ def parse_dataclass_overrides(cls: Any, text: str, flag: str) -> Dict[str, Any]:
                 f"{flag}: adv_norm must be one of {ADV_NORM_MODES}, "
                 f"got {out['adv_norm']!r}"
             )
+    if "advantage" in fields and out.get("advantage") is not None:
+        from dotaclient_tpu.config import ADVANTAGE_MODES
+
+        if out["advantage"] not in ADVANTAGE_MODES:
+            raise ValueError(
+                f"{flag}: advantage must be one of {ADVANTAGE_MODES}, "
+                f"got {out['advantage']!r}"
+            )
     return out

@@ -81,6 +81,73 @@ class TestGAE:
         np.testing.assert_allclose(np.asarray(adv), np.zeros((B, T)), atol=1e-6)
 
 
+class TestVTrace:
+    """V-trace off-policy correction (train/gae.py vtrace) — the IMPALA
+    estimator behind PPOConfig.advantage='vtrace'."""
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    @pytest.mark.parametrize("rho_clip,c_clip", [(1.0, 1.0), (2.0, 1.5)])
+    def test_matches_numpy_reference(self, seed, rho_clip, c_clip):
+        from dotaclient_tpu.train.gae import vtrace, vtrace_reference
+
+        rng = np.random.default_rng(seed)
+        B, T = 4, 16
+        r = rng.normal(size=(B, T)).astype(np.float32)
+        v = rng.normal(size=(B, T + 1)).astype(np.float32)
+        d = (rng.random((B, T)) < 0.15).astype(np.float32)
+        blp = -np.abs(rng.normal(size=(B, T))).astype(np.float32)
+        tlp = blp + rng.normal(size=(B, T)).astype(np.float32) * 0.3
+        a_jax, vs_jax = vtrace(
+            *map(jnp.asarray, (r, v, d, blp, tlp)), 0.99, rho_clip, c_clip
+        )
+        a_np, vs_np = vtrace_reference(
+            r, v, d, blp, tlp, 0.99, rho_clip, c_clip
+        )
+        np.testing.assert_allclose(np.asarray(a_jax), a_np, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(vs_jax), vs_np, rtol=1e-4, atol=1e-5)
+
+    def test_on_policy_reduces_to_gae_lambda_one(self):
+        from dotaclient_tpu.train.gae import vtrace
+
+        rng = np.random.default_rng(3)
+        B, T = 4, 12
+        r = rng.normal(size=(B, T)).astype(np.float32)
+        v = rng.normal(size=(B, T + 1)).astype(np.float32)
+        d = (rng.random((B, T)) < 0.2).astype(np.float32)
+        lp = -np.abs(rng.normal(size=(B, T))).astype(np.float32)
+        pg, vs = vtrace(
+            *map(jnp.asarray, (r, v, d, lp, lp)), 0.99, 1.0, 1.0
+        )
+        adv, ret = gae(
+            jnp.asarray(r), jnp.asarray(v), jnp.asarray(d), 0.99, 1.0
+        )
+        np.testing.assert_allclose(np.asarray(pg), np.asarray(adv), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(vs), np.asarray(ret), rtol=1e-4, atol=1e-5)
+
+    def test_loss_and_train_step_with_vtrace(self, setup):
+        policy, params = setup
+        cfg = dataclasses.replace(CFG.ppo, advantage="vtrace")
+        batch = random_batch(policy, params, seed=9)
+        loss, metrics = ppo_loss(policy, params, batch, cfg)
+        assert np.isfinite(float(loss))
+        run_cfg = dataclasses.replace(CFG, ppo=cfg)
+        mesh = make_mesh(run_cfg.mesh)
+        step = make_train_step(policy, run_cfg, mesh)
+        state = init_train_state(params, cfg)
+        state, m = step(state, batch)
+        assert np.isfinite(float(np.asarray(m["loss"])))
+        assert int(state.step) == 1
+
+    def test_unknown_advantage_mode_raises(self, setup):
+        policy, params = setup
+        batch = random_batch(policy, params)
+        with pytest.raises(ValueError, match="advantage"):
+            ppo_loss(
+                policy, params, batch,
+                dataclasses.replace(CFG.ppo, advantage="bogus"),
+            )
+
+
 class TestLoss:
     def test_finite_and_components(self, setup):
         policy, params = setup
