@@ -109,18 +109,15 @@ class DeviceActor:
             N, config.env.team_size, config.env.hero_pool,
             config.env.opponent, seed,
         )
-        # League anchor games (LeagueConfig.anchor_prob): the first K games
-        # pin the opponent side to a scripted bot — the sim's control-mode
-        # override wins over the snapshot policy's actions there. Keeps
+        # League anchor games: shared scheme with the host vec pool
+        # (envs.vec_lane_sim.apply_anchor_games — the sim's control-mode
+        # override wins over the snapshot policy's actions there). Keeps
         # fight/push behavior in an otherwise pure self-play meta.
-        self.n_anchor_games = 0
-        if config.env.opponent == "league" and config.league.anchor_prob > 0:
-            from dotaclient_tpu.envs.vec_lane_sim import OPPONENT_CONTROL
+        from dotaclient_tpu.envs.vec_lane_sim import apply_anchor_games
 
-            self.n_anchor_games = int(round(config.league.anchor_prob * N))
-            control[: self.n_anchor_games, config.env.team_size:] = (
-                OPPONENT_CONTROL[config.league.anchor_opponent]
-            )
+        self.n_anchor_games = apply_anchor_games(
+            control, config.env.team_size, config.env.opponent, config.league
+        )
         # per-game mask of NON-anchor games: PFSP attribution must not
         # credit/blame a snapshot for games a scripted bot actually played
         self._league_game_mask = jnp.arange(N) >= self.n_anchor_games

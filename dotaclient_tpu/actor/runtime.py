@@ -190,7 +190,7 @@ class ActorPool(WindowedStatsMixin):
         if cfg.env.opponent == "league" and cfg.league.anchor_prob > 0:
             print(
                 "WARNING: league.anchor_prob is implemented by the "
-                "device/fused actors only; this host pool runs pure "
+                "device/fused/vec actors; this scalar pool runs pure "
                 "snapshot self-play (no scripted-anchor games)",
                 flush=True,
             )

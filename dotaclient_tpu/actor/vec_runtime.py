@@ -29,6 +29,7 @@ from dotaclient_tpu.envs.vec_lane_sim import (
     OPPONENT_CONTROL,
     VecLaneSim,
     VecSimSpec,
+    apply_anchor_games,
     draft_games,
 )
 from dotaclient_tpu.features.vec_featurizer import VecFeaturizer, VecRewards
@@ -99,6 +100,13 @@ class VecActorPool(WindowedStatsMixin):
             N, env.team_size, env.hero_pool, env.opponent, seed
         )
         opp_mode = OPPONENT_CONTROL[env.opponent]
+        # No per-game attribution mask here (unlike DeviceActor): host-pool
+        # league draws never feed PFSP outcome attribution (the learner
+        # warns and keeps the uniform prior), so there is nothing for
+        # anchor games to contaminate.
+        self.n_anchor_games = apply_anchor_games(
+            control, env.team_size, env.opponent, config.league
+        )
         self.sim = VecLaneSim(spec, hero_ids, control, seed=seed)
         self._reseed_rng = np.random.default_rng(seed ^ 0x5EED)
 
@@ -118,14 +126,6 @@ class VecActorPool(WindowedStatsMixin):
         self.rewards = VecRewards(
             self.sim, learner_players, weights=dict(config.reward.as_dict())
         )
-        if config.env.opponent == "league" and config.league.anchor_prob > 0:
-            # a knob this pool cannot honor must say so, not silently no-op
-            print(
-                "WARNING: league.anchor_prob is implemented by the "
-                "device/fused actors only; this host pool runs pure "
-                "snapshot self-play (no scripted-anchor games)",
-                flush=True,
-            )
         self._opponent: Optional["_OpponentLanes"] = None
         if opponent_players:
             self._opponent = _OpponentLanes(

@@ -116,6 +116,26 @@ def draft_games(
     return hero_ids, control
 
 
+def apply_anchor_games(
+    control: np.ndarray,    # i32 [N, P] from draft_games — mutated in place
+    team_size: int,
+    opponent: str,
+    league_cfg,             # LeagueConfig
+) -> int:
+    """League anchor games (LeagueConfig.anchor_prob): pin the opponent
+    side of the first K games to the scripted anchor bot, whose sim-side
+    control override wins over any opponent-lane actions. Shared by every
+    vectorized actor (device and host) so the selection scheme cannot
+    drift. Returns K; with ``anchor_prob > 0`` at least one game anchors —
+    a tiny env count must not silently round the knob to a no-op."""
+    if opponent != "league" or league_cfg.anchor_prob <= 0:
+        return 0
+    n = control.shape[0]
+    k = max(1, int(round(league_cfg.anchor_prob * n)))
+    control[:k, team_size:] = OPPONENT_CONTROL[league_cfg.anchor_opponent]
+    return k
+
+
 @dataclasses.dataclass(frozen=True)
 class VecSimSpec:
     """Static layout of a vectorized sim batch."""

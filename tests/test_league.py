@@ -186,6 +186,39 @@ class TestLeagueAnchors:
         assert s["league_episodes"] <= s["episodes"]
         assert s["league_wins"] <= s["wins"]
 
+    def test_vec_pool_anchor_games_pin_scripted_control(self):
+        """The host vec pool honors anchor_prob the same way the device
+        actor does: the first K games' opponent side is scripted via the
+        sim's control-mode override, and the pool still steps."""
+        import numpy as np
+
+        from dotaclient_tpu.actor.vec_runtime import VecActorPool
+        from dotaclient_tpu.models import init_params, make_policy
+        from dotaclient_tpu.protos import dota_pb2 as pb
+
+        cfg = small_config(opponent="league")
+        cfg = dataclasses.replace(
+            cfg,
+            league=dataclasses.replace(
+                cfg.league, enabled=True, anchor_prob=0.5,
+                anchor_opponent="scripted_hard",
+            ),
+        )
+        policy = make_policy(cfg.model, cfg.obs, cfg.actions)
+        params = init_params(policy, jax.random.PRNGKey(0))
+        out: list = []
+        pool = VecActorPool(cfg, policy, params, seed=0, rollout_sink=out.extend)
+        assert pool.n_anchor_games == 2
+        control = np.asarray(pool.sim.control_modes)
+        ts = cfg.env.team_size
+        assert (control[:2, ts:] == pb.CONTROL_SCRIPTED_HARD).all()
+        assert (control[2:, ts:] == pb.CONTROL_AGENT).all()
+        assert (control[:, :ts] == pb.CONTROL_AGENT).all()
+        pool.set_opponent(init_params(policy, jax.random.PRNGKey(9)), 0)
+        for _ in range(cfg.ppo.rollout_len):
+            pool.step()
+        assert out, "anchored vec pool must still ship rollouts"
+
     def test_learner_league_with_anchors_trains(self):
         from dotaclient_tpu.train.learner import Learner
 
