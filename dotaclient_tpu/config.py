@@ -232,6 +232,10 @@ class LeagueConfig:
     # farming equilibrium"); anchors keep fight/push behavior in the
     # training distribution. Anchor outcomes are excluded from PFSP stats.
     anchor_prob: float = 0.0
+    # "scripted_easy" | "scripted_hard" | "mixed" (half each, easy takes
+    # the odd game). Measured (BASELINE.md 30k league run): anchoring only
+    # vs hard improved the hard-bot eval but collapsed the easy-bot eval —
+    # the meta only covers strategies in the anchor distribution.
     anchor_opponent: str = "scripted_hard"
 
 

@@ -132,7 +132,18 @@ def apply_anchor_games(
         return 0
     n = control.shape[0]
     k = max(1, int(round(league_cfg.anchor_prob * n)))
-    control[:k, team_size:] = OPPONENT_CONTROL[league_cfg.anchor_opponent]
+    name = league_cfg.anchor_opponent
+    if name == "mixed":
+        # Strategy coverage follows the anchor distribution (measured:
+        # hard-only anchors collapsed the easy-bot eval, BASELINE.md 30k
+        # league run) — split anchors across both scripted bots, easy
+        # taking the odd game (it is the aggression test, the style pure
+        # self-play loses first).
+        n_easy = (k + 1) // 2
+        control[:n_easy, team_size:] = OPPONENT_CONTROL["scripted_easy"]
+        control[n_easy:k, team_size:] = OPPONENT_CONTROL["scripted_hard"]
+    else:
+        control[:k, team_size:] = OPPONENT_CONTROL[name]
     return k
 
 

@@ -219,6 +219,33 @@ class TestLeagueAnchors:
             pool.step()
         assert out, "anchored vec pool must still ship rollouts"
 
+    def test_mixed_anchors_split_between_both_bots(self):
+        import numpy as np
+
+        from dotaclient_tpu.envs.vec_lane_sim import (
+            apply_anchor_games, draft_games,
+        )
+        from dotaclient_tpu.protos import dota_pb2 as pb
+
+        cfg = small_config(opponent="league")
+        league = dataclasses.replace(
+            cfg.league, enabled=True, anchor_prob=1.0,
+            anchor_opponent="mixed",
+        )
+        _, control = draft_games(4, cfg.env.team_size, (1,), "league", 0)
+        k = apply_anchor_games(control, cfg.env.team_size, "league", league)
+        assert k == 4
+        ts = cfg.env.team_size
+        assert (control[:2, ts:] == pb.CONTROL_SCRIPTED_EASY).all()
+        assert (control[2:4, ts:] == pb.CONTROL_SCRIPTED_HARD).all()
+        # odd count: easy takes the extra game
+        _, control = draft_games(3, cfg.env.team_size, (1,), "league", 0)
+        league = dataclasses.replace(league, anchor_prob=1.0)
+        k = apply_anchor_games(control, cfg.env.team_size, "league", league)
+        assert k == 3
+        assert (control[:2, ts:] == pb.CONTROL_SCRIPTED_EASY).all()
+        assert (control[2:3, ts:] == pb.CONTROL_SCRIPTED_HARD).all()
+
     def test_learner_league_with_anchors_trains(self):
         from dotaclient_tpu.train.learner import Learner
 
