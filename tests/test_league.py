@@ -240,7 +240,6 @@ class TestLeagueAnchors:
         assert (control[2:4, ts:] == pb.CONTROL_SCRIPTED_HARD).all()
         # odd count: easy takes the extra game
         _, control = draft_games(3, cfg.env.team_size, (1,), "league", 0)
-        league = dataclasses.replace(league, anchor_prob=1.0)
         k = apply_anchor_games(control, cfg.env.team_size, "league", league)
         assert k == 3
         assert (control[:2, ts:] == pb.CONTROL_SCRIPTED_EASY).all()
