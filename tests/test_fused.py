@@ -276,6 +276,54 @@ class TestFusedStep:
         with pytest.raises(ValueError, match="steps_per_dispatch"):
             Learner(cfg, actor="device")
 
+    def test_fused_under_tensor_parallelism_matches_single_device(self):
+        """The fused program with a (data, model=2) mesh must produce the
+        same training trajectory as the single-device fused program —
+        the TP equivalence guarantee (test_parallel) extended to the
+        rollout+update fusion."""
+        from dotaclient_tpu.actor.device_rollout import DeviceActor
+        from dotaclient_tpu.models import init_params, make_policy
+        from dotaclient_tpu.parallel import make_mesh
+        from dotaclient_tpu.train.fused import make_fused_step
+        from dotaclient_tpu.train.ppo import init_train_state
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8 forced host devices")
+        cfg = tiny_cfg(n_envs=16)   # 16 lanes / 4 data shards under TP
+        policy = make_policy(cfg.model, cfg.obs, cfg.actions)
+        params = init_params(policy, jax.random.PRNGKey(0))
+
+        def run(cfg_run, devices):
+            mesh = make_mesh(cfg_run.mesh, devices=devices)
+            actor = DeviceActor(cfg_run, policy, seed=5)
+            fused = make_fused_step(policy, cfg_run, mesh, actor)
+            state = init_train_state(params, cfg_run.ppo)
+            for _ in range(2):
+                state, actor_state, metrics, _stats = fused(
+                    state, actor.state, state.params
+                )
+                actor.state = actor_state
+            return state, metrics
+
+        s1, m1 = run(cfg, jax.devices()[:1])
+        cfg_tp = dataclasses.replace(
+            cfg, mesh=dataclasses.replace(cfg.mesh, model_parallel=2)
+        )
+        s2, m2 = run(cfg_tp, jax.devices())
+        # params actually partition over the model axis under TP
+        kernel = s2.params["params"]["core"]["hi"]["kernel"]
+        assert "model" in str(kernel.sharding.spec)
+        np.testing.assert_allclose(
+            float(np.asarray(m1["loss"])), float(np.asarray(m2["loss"])),
+            rtol=2e-4, atol=2e-5,
+        )
+        for a, b in zip(
+            jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+            )
+
     def test_fused_league_uses_frozen_opponent(self):
         from dotaclient_tpu.train.learner import Learner
 
