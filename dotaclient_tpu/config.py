@@ -232,11 +232,16 @@ class LeagueConfig:
     # farming equilibrium"); anchors keep fight/push behavior in the
     # training distribution. Anchor outcomes are excluded from PFSP stats.
     anchor_prob: float = 0.0
-    # "scripted_easy" | "scripted_hard" | "mixed" (half each, easy takes
-    # the odd game). Measured (BASELINE.md 30k league run): anchoring only
-    # vs hard improved the hard-bot eval but collapsed the easy-bot eval —
-    # the meta only covers strategies in the anchor distribution.
+    # "scripted_easy" | "scripted_hard" | "mixed". Measured (BASELINE.md 30k
+    # league run): anchoring only vs hard improved the hard-bot eval but
+    # collapsed the easy-bot eval — the meta only covers strategies in the
+    # anchor distribution.
     anchor_opponent: str = "scripted_hard"
+    # "mixed" only: fraction of anchor games played vs scripted_easy (the
+    # rest vs scripted_hard), easy rounding up. The 10k mixed-anchor run
+    # (BASELINE.md) showed 12.5% easy games does not fully offset the shaped
+    # reward's farming pull on the easy-bot eval — this is the knob to raise.
+    anchor_easy_share: float = 0.5
 
 
 @dataclasses.dataclass(frozen=True)

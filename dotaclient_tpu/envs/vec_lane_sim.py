@@ -38,6 +38,7 @@ bot, last-hit gold arrives, towers fall, timeouts adjudicate identically).
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
@@ -136,10 +137,23 @@ def apply_anchor_games(
     if name == "mixed":
         # Strategy coverage follows the anchor distribution (measured:
         # hard-only anchors collapsed the easy-bot eval, BASELINE.md 30k
-        # league run) — split anchors across both scripted bots, easy
-        # taking the odd game (it is the aggression test, the style pure
-        # self-play loses first).
-        n_easy = (k + 1) // 2
+        # league run) — split anchors across both scripted bots per
+        # anchor_easy_share, easy rounding up (it is the aggression test,
+        # the style pure self-play loses first).
+        share = min(1.0, max(0.0, league_cfg.anchor_easy_share))
+        # round-before-ceil: float products like 0.07*100 == 7.0000…01
+        # must not bump the easy count past the intended share
+        n_easy = int(math.ceil(round(share * k, 9)))
+        if 0.0 < share < 1.0:
+            if k >= 2:
+                # a fractional share means BOTH bots were requested —
+                # neither may round to zero games (same principle as the
+                # max(1, ...) guard above)
+                n_easy = min(k - 1, max(1, n_easy))
+            else:
+                # one anchor game cannot host both bots: the majority
+                # bot takes it (round-up-to-easy would invert a 0.1 share)
+                n_easy = 1 if share >= 0.5 else 0
         control[:n_easy, team_size:] = OPPONENT_CONTROL["scripted_easy"]
         control[n_easy:k, team_size:] = OPPONENT_CONTROL["scripted_hard"]
     else:

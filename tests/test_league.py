@@ -245,6 +245,44 @@ class TestLeagueAnchors:
         assert (control[:2, ts:] == pb.CONTROL_SCRIPTED_EASY).all()
         assert (control[2:3, ts:] == pb.CONTROL_SCRIPTED_HARD).all()
 
+    def test_anchor_easy_share_shifts_the_mix(self):
+        from dotaclient_tpu.envs.vec_lane_sim import (
+            apply_anchor_games, draft_games,
+        )
+        from dotaclient_tpu.protos import dota_pb2 as pb
+
+        cfg = small_config(opponent="league")
+        ts = cfg.env.team_size
+        # 0.9: ceil(3.6)=4 would erase the hard anchor — capped at k-1
+        for share, n_easy in ((0.75, 3), (0.0, 0), (1.0, 4), (0.9, 3),
+                              (0.01, 1)):
+            league = dataclasses.replace(
+                cfg.league, enabled=True, anchor_prob=1.0,
+                anchor_opponent="mixed", anchor_easy_share=share,
+            )
+            _, control = draft_games(4, ts, (1,), "league", 0)
+            k = apply_anchor_games(control, ts, "league", league)
+            assert k == 4
+            easy = (
+                control[:, ts:] == pb.CONTROL_SCRIPTED_EASY
+            ).all(axis=1)
+            assert easy.sum() == n_easy, (share, easy)
+            assert (
+                control[n_easy:, ts:] == pb.CONTROL_SCRIPTED_HARD
+            ).all()
+        # k=1: the single anchor goes to the MAJORITY bot (round-up-to-
+        # easy would invert a mostly-hard share)
+        for share, bot in ((0.1, pb.CONTROL_SCRIPTED_HARD),
+                           (0.9, pb.CONTROL_SCRIPTED_EASY)):
+            league = dataclasses.replace(
+                cfg.league, enabled=True, anchor_prob=0.25,
+                anchor_opponent="mixed", anchor_easy_share=share,
+            )
+            _, control = draft_games(4, ts, (1,), "league", 0)
+            k = apply_anchor_games(control, ts, "league", league)
+            assert k == 1
+            assert (control[0, ts:] == bot).all()
+
     def test_learner_league_with_anchors_trains(self):
         from dotaclient_tpu.train.learner import Learner
 
