@@ -79,6 +79,7 @@ def main() -> None:
 
     # -- end-to-end: full pipeline, steady state -----------------------------
     import dataclasses
+    import tempfile
 
     from dotaclient_tpu.train.learner import Learner
 
@@ -92,7 +93,14 @@ def main() -> None:
         ),
         log_every=10_000,
     )
-    learner = Learner(e2e_config, actor="device")
+    # JSONL telemetry sink: the BENCH line carries a per-stage latency
+    # breakdown (actor dispatch / buffer insert+sample / learner dispatch)
+    # next to the headline number, so a frames/sec regression names its stage.
+    fd, telemetry_path = tempfile.mkstemp(
+        suffix=".jsonl", prefix="tpu_dota_bench_telemetry_"
+    )
+    os.close(fd)   # fresh per-run record; path is printed with the results
+    learner = Learner(e2e_config, actor="device", metrics_jsonl=telemetry_path)
     learner.train(20)   # warmup: compiles + buffer fill
     # Best of 3: the tunneled-TPU service shows multi-second warm-up
     # hiccups on a fresh process's first sustained run (measured: identical
@@ -151,6 +159,28 @@ def main() -> None:
     jax.block_until_ready(chunk["rewards"])
     actor_fps = n_collect * da.n_lanes * T / (time.perf_counter() - t0)
 
+    # Per-stage breakdown from the last telemetry snapshot of the e2e run
+    # (EMA seconds per stage + the pipeline-health gauges).
+    stages = {}
+    try:
+        with open(telemetry_path) as f:
+            lines = f.read().splitlines()
+        last = json.loads(lines[-1])["scalars"] if lines else {}
+        for label, key in (
+            ("actor_collect_ema_s", "span/actor/collect/ema_s"),
+            ("buffer_insert_ema_s", "span/buffer/insert/ema_s"),
+            ("buffer_sample_ema_s", "span/buffer/sample/ema_s"),
+            ("learner_dispatch_ema_s", "span/learner/dispatch/ema_s"),
+            ("metrics_fetch_ema_s", "span/learner/metrics_fetch/ema_s"),
+            ("buffer_occupancy", "buffer/occupancy"),
+            ("queue_depth", "transport/queue_depth"),
+            ("weight_staleness", "actor/weight_staleness"),
+        ):
+            if key in last and last[key] is not None:
+                stages[label] = round(float(last[key]), 6)
+    except (OSError, ValueError, KeyError, IndexError):
+        stages = {}
+
     anchor = None
     if os.path.exists(ANCHOR_PATH):
         try:
@@ -181,6 +211,8 @@ def main() -> None:
                 "fused_frames_per_sec": round(fused_fps, 1),
                 "fused_k8_frames_per_sec": round(k8_fps, 1),
                 "actor_frames_per_sec": round(actor_fps, 1),
+                "stages": stages,
+                "telemetry_jsonl": telemetry_path,
             }
         )
     )

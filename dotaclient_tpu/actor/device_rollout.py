@@ -37,6 +37,7 @@ from dotaclient_tpu.features.jax_featurizer import (
 from dotaclient_tpu.models import distributions as D
 from dotaclient_tpu.models.policy import Policy, mask_carry
 from dotaclient_tpu.protos import dota_pb2 as pb
+from dotaclient_tpu.utils import telemetry
 
 
 class DeviceActorState(NamedTuple):
@@ -87,7 +88,13 @@ class DeviceActor:
     chunk) rather than stored setter state.
     """
 
-    def __init__(self, config: RunConfig, policy: Policy, seed: int = 0) -> None:
+    def __init__(
+        self,
+        config: RunConfig,
+        policy: Policy,
+        seed: int = 0,
+        registry: Optional[telemetry.Registry] = None,
+    ) -> None:
         self.config = config
         self.policy = policy
         self.spec = build_spec(config)
@@ -145,6 +152,7 @@ class DeviceActor:
         self.wins = 0
         self._reward_sum = 0.0
         self._ep_count_window = 0.0
+        self._tel = registry if registry is not None else telemetry.get_registry()
 
     @staticmethod
     def _zero_stats() -> Dict[str, jnp.ndarray]:
@@ -313,17 +321,26 @@ class DeviceActor:
             )
         if opp_params is None:
             opp_params = params
-        self.state, chunk, stats = self._rollout(params, self.state, opp_params)
+        # span measures DISPATCH latency only (the program runs async on the
+        # device) — watching it grow is how you spot the device falling
+        # behind the host without adding a sync to look
+        with self._tel.span("actor/collect"):
+            self.state, chunk, stats = self._rollout(
+                params, self.state, opp_params
+            )
         T = self.config.ppo.rollout_len
         self.env_steps += self.n_lanes * T
         self.rollouts_shipped += self.n_lanes
+        self._tel.counter("actor/frames_shipped").inc(self.n_lanes * T)
+        self._tel.counter("actor/rollouts_shipped").inc(self.n_lanes)
         return chunk, stats
 
     def drain_stats(self) -> Dict[str, float]:
         """Fetch the device-accumulated episode stats (4 scalars, ONE host
         sync regardless of how many chunks were collected); call at log
         boundaries only."""
-        s = jax.device_get(self.state.stats)
+        with self._tel.span("actor/drain"):
+            s = jax.device_get(self.state.stats)
         self.state = self.state._replace(stats=self._zero_stats())
         self.episodes_done += int(s["episodes"])
         self.wins += int(s["wins"])

@@ -43,6 +43,7 @@ import numpy as np
 
 from dotaclient_tpu.actor.window_stats import WindowedStatsMixin
 from dotaclient_tpu.config import RunConfig
+from dotaclient_tpu.utils import telemetry
 from dotaclient_tpu.envs.env_api import LocalDotaEnv
 from dotaclient_tpu.envs import lane_sim
 from dotaclient_tpu.features import (
@@ -180,6 +181,7 @@ class ActorPool(WindowedStatsMixin):
         self.episodes_done = 0
         self.episode_rewards: List[float] = []
         self.wins = 0
+        self._tel = telemetry.get_registry()
 
     # -- env / lane lifecycle ---------------------------------------------
 
@@ -279,6 +281,11 @@ class ActorPool(WindowedStatsMixin):
         msg = self.transport.latest_weights()
         if msg is None or msg.version == self.version:
             return False
+        # how far behind this actor was when it caught up — the per-actor
+        # refresh lag (IMPACT-style staleness accounting, PAPERS.md)
+        self._tel.gauge("actor/weight_refresh_lag").set(
+            msg.version - self.version
+        )
         version, tree = decode_weights(msg)
         self._weights = (jax.tree.map(jnp.asarray, tree), version)
         return True
@@ -286,6 +293,7 @@ class ActorPool(WindowedStatsMixin):
     def set_params(self, params: Any, version: int) -> None:
         """Direct replicated-params refresh (in-process learner path — the
         'actors read replicated JAX params' mode of BASELINE.json:5)."""
+        self._tel.gauge("actor/weight_refresh_lag").set(version - self.version)
         self._weights = (params, version)
 
     @property
@@ -298,20 +306,26 @@ class ActorPool(WindowedStatsMixin):
 
     def step(self) -> None:
         """Advance every lane by one environment step."""
+        with self._tel.span("actor/step"):
+            self._step_impl()
+        self._tel.counter("actor/env_steps").inc(len(self.lanes))
+
+    def _step_impl(self) -> None:
         obs_batch = stack_observations([l.obs for l in self.lanes])
         # One atomic weights read serves the whole step: dispatch uses these
         # params, and chunks beginning this step are tagged with this version.
         params, self._chunk_version = self._weights
-        host_out, (self._carry_dev, self._key_dev) = self._step_fn(
-            params,
-            obs_batch,
-            self._carry_dev,
-            self._key_dev,
-            self._reset_mask,
-        )
-        # ONE host transfer for everything the host loop needs this step —
-        # per-array fetches each pay a full device round trip.
-        actions_np, logp_np, carry_np = jax.device_get(host_out)
+        with self._tel.span("actor/infer"):
+            host_out, (self._carry_dev, self._key_dev) = self._step_fn(
+                params,
+                obs_batch,
+                self._carry_dev,
+                self._key_dev,
+                self._reset_mask,
+            )
+            # ONE host transfer for everything the host loop needs this step —
+            # per-array fetches each pay a full device round trip.
+            actions_np, logp_np, carry_np = jax.device_get(host_out)
         self._reset_mask[:] = False
 
         # Submit actions grouped per (env, team) — env steps once all agent
@@ -432,6 +446,8 @@ class ActorPool(WindowedStatsMixin):
         elif self.transport is not None:
             self.transport.publish_rollout(rollout)
         self.rollouts_shipped += 1
+        self._tel.counter("actor/rollouts_shipped").inc()
+        self._tel.counter("actor/frames_shipped").inc(n)
 
     def run(self, n_steps: int, refresh_every: int = 8) -> Dict[str, float]:
         """Drive the pool for ``n_steps`` batched steps; returns stats."""

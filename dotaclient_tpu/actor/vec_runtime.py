@@ -17,6 +17,7 @@ SURVEY.md §2.4).
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -25,6 +26,7 @@ import numpy as np
 
 from dotaclient_tpu.actor.window_stats import WindowedStatsMixin
 from dotaclient_tpu.config import RunConfig
+from dotaclient_tpu.utils import telemetry
 from dotaclient_tpu.envs.vec_lane_sim import (
     OPPONENT_CONTROL,
     VecLaneSim,
@@ -165,6 +167,7 @@ class VecActorPool(WindowedStatsMixin):
         self.episodes_done = 0
         self.episode_rewards: List[float] = []
         self.wins = 0
+        self._tel = telemetry.get_registry()
 
     # -- weights -----------------------------------------------------------
 
@@ -177,6 +180,9 @@ class VecActorPool(WindowedStatsMixin):
         return self._weights[1]
 
     def set_params(self, params: Any, version: int) -> None:
+        # per-actor refresh lag: how many optimizer versions this pool was
+        # behind at the moment it caught up (IMPACT-style staleness)
+        self._tel.gauge("actor/weight_refresh_lag").set(version - self.version)
         self._weights = (params, version)
 
     def set_opponent(self, params: Any, version: int) -> None:
@@ -191,6 +197,9 @@ class VecActorPool(WindowedStatsMixin):
         msg = self.transport.latest_weights()
         if msg is None or msg.version == self.version:
             return False
+        self._tel.gauge("actor/weight_refresh_lag").set(
+            msg.version - self.version
+        )
         version, tree = decode_weights(msg)
         self._weights = (jax.tree.map(jnp.asarray, tree), version)
         return True
@@ -199,6 +208,11 @@ class VecActorPool(WindowedStatsMixin):
 
     def step(self) -> None:
         """Advance every game one step: one device dispatch, one fetch."""
+        with self._tel.span("actor/step"):
+            self._step_impl()
+        self._tel.counter("actor/env_steps").inc(self.n_lanes)
+
+    def _step_impl(self) -> None:
         cfg = self.config
         T = cfg.ppo.rollout_len
         L = self.n_lanes
@@ -206,13 +220,22 @@ class VecActorPool(WindowedStatsMixin):
         obs = self._pending_obs
         params, version = self._weights
 
+        # actor/infer = jitted dispatch + the one host fetch. The opponent
+        # stays between them (overlapping the device) but its host compute
+        # must not be attributed to inference, so time the two segments
+        # explicitly instead of spanning the whole block.
+        t0 = time.perf_counter()
         host_out, (self._carry_dev, self._key_dev) = self._step_fn(
             params, obs, self._carry_dev, self._key_dev, self._reset_mask
         )
+        infer_s = time.perf_counter() - t0
         opp_actions = None
         if self._opponent is not None:
             opp_actions = self._opponent.step()
+        t1 = time.perf_counter()
         actions_np, logp_np, carry_np = jax.device_get(host_out)
+        infer_s += time.perf_counter() - t1
+        self._tel.timer("span/actor/infer").observe(infer_s)
         self._reset_mask[:] = False
 
         # record pre-action obs + sampled actions at each lane's cursor
@@ -320,6 +343,10 @@ class VecActorPool(WindowedStatsMixin):
                     jax.tree.leaves(self._carry0), jax.tree.leaves(carry_np)
                 ):
                     buf[l] = src[l]
+        self._tel.counter("actor/rollouts_shipped").inc(len(out))
+        self._tel.counter("actor/frames_shipped").inc(
+            float(sum(m["length"] for m, _ in out))
+        )
         if self.rollout_sink is not None:
             self.rollout_sink(out)
         elif self.transport is not None:

@@ -27,6 +27,7 @@ from jax.sharding import Mesh
 
 from dotaclient_tpu.config import RunConfig
 from dotaclient_tpu.train.ppo import example_batch
+from dotaclient_tpu.utils import telemetry
 
 
 class TrajectoryBuffer:
@@ -37,9 +38,15 @@ class TrajectoryBuffer:
     "Async off-policy DP").
     """
 
-    def __init__(self, config: RunConfig, mesh: Mesh) -> None:
+    def __init__(
+        self,
+        config: RunConfig,
+        mesh: Mesh,
+        registry: Optional[telemetry.Registry] = None,
+    ) -> None:
         self.config = config
         self.mesh = mesh
+        self._tel = registry if registry is not None else telemetry.get_registry()
         from dotaclient_tpu.parallel.mesh import batch_axes, data_sharding
 
         axes = batch_axes(mesh, config.mesh)
@@ -161,38 +168,42 @@ class TrajectoryBuffer:
             self.dropped_overflow += len(fresh) - self.capacity
             fresh = fresh[-self.capacity:]
         if not fresh:
+            self._publish_telemetry()
             return 0
 
-        rows = jax.tree.map(
-            lambda *xs: np.stack(xs), *[arrays for _, arrays in fresh]
-        )
-        # Allocate slots: free ones first, then evict oldest unconsumed.
-        slots = []
-        for _ in fresh:
-            if self._free:
-                slots.append(self._free.pop())
-            else:
-                slots.append(self._order.popleft())
-                self.dropped_overflow += 1
-        idx = np.asarray(slots, dtype=np.int32)
-        # Scatter in power-of-two chunks (binary decomposition of the ingest
-        # count): a varying leading dim would compile one XLA program per
-        # distinct count — up to `capacity` of them (ADVICE round 1). This
-        # bounds it at log2(capacity) programs. numpy rows transfer on the
-        # dispatch path (no separate synchronizing device_put).
-        pos = 0
-        remaining = len(fresh)
-        while remaining:
-            chunk = 1 << (remaining.bit_length() - 1)
-            rows_chunk = jax.tree.map(lambda r: r[pos:pos + chunk], rows)
-            self._store = self._scatter(
-                self._store, rows_chunk, idx[pos:pos + chunk]
+        with self._tel.span("buffer/insert"):
+            rows = jax.tree.map(
+                lambda *xs: np.stack(xs), *[arrays for _, arrays in fresh]
             )
-            pos += chunk
-            remaining -= chunk
-        self._slot_version[idx] = [m["model_version"] for m, _ in fresh]
-        self._order.extend(slots)
-        self.ingested += len(fresh)
+            # Allocate slots: free ones first, then evict oldest unconsumed.
+            slots = []
+            for _ in fresh:
+                if self._free:
+                    slots.append(self._free.pop())
+                else:
+                    slots.append(self._order.popleft())
+                    self.dropped_overflow += 1
+            idx = np.asarray(slots, dtype=np.int32)
+            # Scatter in power-of-two chunks (binary decomposition of the
+            # ingest count): a varying leading dim would compile one XLA
+            # program per distinct count — up to `capacity` of them (ADVICE
+            # round 1). This bounds it at log2(capacity) programs. numpy rows
+            # transfer on the dispatch path (no separate synchronizing
+            # device_put).
+            pos = 0
+            remaining = len(fresh)
+            while remaining:
+                chunk = 1 << (remaining.bit_length() - 1)
+                rows_chunk = jax.tree.map(lambda r: r[pos:pos + chunk], rows)
+                self._store = self._scatter(
+                    self._store, rows_chunk, idx[pos:pos + chunk]
+                )
+                pos += chunk
+                remaining -= chunk
+            self._slot_version[idx] = [m["model_version"] for m, _ in fresh]
+            self._order.extend(slots)
+            self.ingested += len(fresh)
+        self._publish_telemetry()
         return len(fresh)
 
     def _matches_slot(self, arrays: Any) -> bool:
@@ -218,29 +229,31 @@ class TrajectoryBuffer:
         construction, so no staleness filter runs here; the slots are still
         version-tagged for consume-time re-checks.
         """
-        L = chunk["valid"].shape[0]
-        take = min(L, self.capacity)
-        if take < L:
-            self.dropped_overflow += L - take
-        slots = []
-        for _ in range(take):
-            if self._free:
-                slots.append(self._free.pop())
-            else:
-                slots.append(self._order.popleft())
-                self.dropped_overflow += 1
-        idx = np.asarray(slots, dtype=np.int32)
-        pos = 0
-        remaining = take
-        while remaining:
-            n = 1 << (remaining.bit_length() - 1)
-            rows = jax.tree.map(lambda r: r[pos:pos + n], chunk)
-            self._store = self._scatter(self._store, rows, idx[pos:pos + n])
-            pos += n
-            remaining -= n
-        self._slot_version[idx] = version
-        self._order.extend(slots)
-        self.ingested += take
+        with self._tel.span("buffer/insert"):
+            L = chunk["valid"].shape[0]
+            take = min(L, self.capacity)
+            if take < L:
+                self.dropped_overflow += L - take
+            slots = []
+            for _ in range(take):
+                if self._free:
+                    slots.append(self._free.pop())
+                else:
+                    slots.append(self._order.popleft())
+                    self.dropped_overflow += 1
+            idx = np.asarray(slots, dtype=np.int32)
+            pos = 0
+            remaining = take
+            while remaining:
+                n = 1 << (remaining.bit_length() - 1)
+                rows = jax.tree.map(lambda r: r[pos:pos + n], chunk)
+                self._store = self._scatter(self._store, rows, idx[pos:pos + n])
+                pos += n
+                remaining -= n
+            self._slot_version[idx] = version
+            self._order.extend(slots)
+            self.ingested += take
+        self._publish_telemetry()
         return take
 
     # -- consume -----------------------------------------------------------
@@ -280,9 +293,18 @@ class TrajectoryBuffer:
             self._warmed = True
         if self.size < b:
             return None
-        idx = np.asarray([self._order.popleft() for _ in range(b)], np.int32)
-        batch = self._gather(self._store, idx)
-        self._free.extend(int(s) for s in idx)
+        with self._tel.span("buffer/sample"):
+            idx = np.asarray([self._order.popleft() for _ in range(b)], np.int32)
+            batch = self._gather(self._store, idx)
+            self._free.extend(int(s) for s in idx)
+        if current_version is not None:
+            # host-side ints: how far behind the optimizer the experience in
+            # this batch is, in optimizer steps (the IMPACT-style staleness
+            # signal the --overlap path needs; 0 on the on-device path)
+            self._tel.gauge("buffer/batch_staleness").set(
+                float(current_version - self._slot_version[idx].mean())
+            )
+        self._publish_telemetry()
         return batch
 
     # -- checkpointing -----------------------------------------------------
@@ -328,6 +350,18 @@ class TrajectoryBuffer:
         self.dropped_stale = stale
         self.dropped_overflow = overflow
         self.ingested = ingested
+
+    def _publish_telemetry(self) -> None:
+        """Mirror the host-side bookkeeping into the registry (gauges are
+        cheap host writes; called at ingest/consume, never mid-dispatch)."""
+        self._tel.gauge("buffer/occupancy").set(float(self.size))
+        self._tel.gauge("buffer/capacity").set(float(self.capacity))
+        self._tel.gauge("buffer/ingested").set(float(self.ingested))
+        self._tel.gauge("buffer/dropped_stale").set(float(self.dropped_stale))
+        self._tel.gauge("buffer/dropped_overflow").set(
+            float(self.dropped_overflow)
+        )
+        self._tel.gauge("buffer/dropped_skew").set(float(self.dropped_skew))
 
     def metrics(self) -> Dict[str, float]:
         return {

@@ -18,6 +18,7 @@ import numpy as np
 
 from dotaclient_tpu.config import RunConfig
 from dotaclient_tpu.models.policy import Policy
+from dotaclient_tpu.utils import telemetry
 
 
 def evaluate(
@@ -39,6 +40,7 @@ def evaluate(
     """
     from dotaclient_tpu.actor.device_rollout import DeviceActor
 
+    tel = telemetry.get_registry()
     eval_cfg = dataclasses.replace(
         config,
         env=dataclasses.replace(config.env, n_envs=n_games, opponent=opponent),
@@ -47,7 +49,10 @@ def evaluate(
         # to the scripted bot and contaminate the reported win_rate
         league=dataclasses.replace(config.league, anchor_prob=0.0),
     )
-    actor = DeviceActor(eval_cfg, policy, seed=seed)
+    # the eval actor records into a PRIVATE registry: its frames/collect
+    # latencies (different config, different cadence) must not contaminate
+    # the training pipeline's counters and EMAs in the global registry
+    actor = DeviceActor(eval_cfg, policy, seed=seed, registry=telemetry.Registry())
     steps_per_episode = eval_cfg.env.max_dota_time / (
         eval_cfg.env.ticks_per_observation / 30.0
     )
@@ -56,13 +61,19 @@ def evaluate(
         2 * steps_per_episode / config.ppo.rollout_len + 2
     )
     done = 0.0
-    for _ in range(max_chunks):
-        actor.collect(params, opp_params=opponent_params)
-        if _ % 8 == 7:
-            done = actor.drain_stats()["episodes_done"]
-            if done >= n_games:
-                break
-    stats = actor.drain_stats()
+    with tel.span("league/evaluate"):
+        for _ in range(max_chunks):
+            actor.collect(params, opp_params=opponent_params)
+            if _ % 8 == 7:
+                done = actor.drain_stats()["episodes_done"]
+                if done >= n_games:
+                    break
+        stats = actor.drain_stats()
+    # evaluation outcomes ride the shared registry so an attached sink
+    # (JSONL/tensorboard) records them next to the pipeline telemetry
+    tel.gauge("league/eval_win_rate").set(stats["win_rate"])
+    tel.gauge("league/eval_episodes").set(stats["episodes_done"])
+    tel.gauge("league/eval_reward_mean").set(stats["episode_reward_mean"])
     return {
         "win_rate": stats["win_rate"],
         "episodes": stats["episodes_done"],

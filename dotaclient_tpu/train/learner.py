@@ -55,6 +55,7 @@ from dotaclient_tpu.transport import (
     decode_rollout,
     encode_weights,
 )
+from dotaclient_tpu.utils import telemetry
 from dotaclient_tpu.utils.checkpoint import CheckpointManager, shape_mismatches
 from dotaclient_tpu.utils.metrics import MetricsLogger
 
@@ -74,6 +75,7 @@ class Learner:
         vec: bool = True,
         actor: Optional[str] = None,
         debug_checkify: bool = False,
+        metrics_jsonl: Optional[str] = None,
     ) -> None:
         # actor mode: "device" (on-device rollout scan feeding the buffered
         # learner), "fused" (rollout + PPO update in ONE XLA program — the
@@ -375,7 +377,8 @@ class Learner:
                         "prior and behave as uniform",
                         flush=True,
                     )
-        self.metrics = MetricsLogger(logdir)
+        self.telemetry = telemetry.get_registry()
+        self.metrics = MetricsLogger(logdir, jsonl=metrics_jsonl)
         self.frames_per_rollout = config.ppo.rollout_len
         # Minibatch machinery: one jitted gather (a tree of row-gathers is
         # otherwise a dispatch per leaf), host RNG for the shuffles, and the
@@ -409,6 +412,10 @@ class Learner:
     # -- loop --------------------------------------------------------------
 
     def ingest(self) -> int:
+        with self.telemetry.span("learner/consume"):
+            return self._ingest_impl()
+
+    def _ingest_impl(self) -> int:
         if self._sink is not None:
             rollouts = []
             cap = self.config.buffer.capacity_rollouts
@@ -443,7 +450,8 @@ class Learner:
         M = max(1, cfg.minibatches)
         for _ in range(cfg.epochs_per_batch):
             if M == 1:
-                self.state, m = self.train_step(self.state, batch)
+                with self.telemetry.span("learner/dispatch"):
+                    self.state, m = self.train_step(self.state, batch)
                 self._host_step += 1
                 self._host_version += 1
                 continue
@@ -452,9 +460,11 @@ class Learner:
             perm = self._mb_rng.permutation(B)
             self._mb_draws += 1
             for i in range(M):
-                idx = jnp.asarray(perm[i * mb:(i + 1) * mb], jnp.int32)
-                sub = self._minibatch_gather(batch, idx)
-                self.state, m = self.train_step(self.state, sub)
+                with self.telemetry.span("learner/assemble"):
+                    idx = jnp.asarray(perm[i * mb:(i + 1) * mb], jnp.int32)
+                    sub = self._minibatch_gather(batch, idx)
+                with self.telemetry.span("learner/dispatch"):
+                    self.state, m = self.train_step(self.state, sub)
                 self._host_step += 1
                 self._host_version += 1
         return m
@@ -512,12 +522,13 @@ class Learner:
     def _publish_weights(self) -> None:
         """Serialize current params to the transport's weights fanout (one
         full param fetch — call at refresh cadence, not per step)."""
-        self.transport.publish_weights(
-            encode_weights(
-                jax.tree.map(np.asarray, self.state.params),
-                self._host_version,
+        with self.telemetry.span("transport/publish_weights"):
+            self.transport.publish_weights(
+                encode_weights(
+                    jax.tree.map(np.asarray, self.state.params),
+                    self._host_version,
+                )
             )
-        )
 
     def _league_opponent(self):
         """Snapshot-if-due and return the current frozen opponent for the
@@ -621,6 +632,24 @@ class Learner:
                 )
             os.replace(tmp, meta)
 
+    def _publish_pipeline_gauges(self) -> None:
+        """Refresh the cross-stage gauges at a log boundary: actor weight
+        staleness (host version mirror minus the actor pool's in-use
+        version — 0 for the on-policy device/fused paths, which have no
+        separate actor copy) and the transport's experience-queue depth.
+        Host integers only — no device traffic."""
+        pool_version = getattr(self.pool, "version", None)
+        self.telemetry.gauge("actor/weight_staleness").set(
+            float(self._host_version - pool_version)
+            if pool_version is not None
+            else 0.0
+        )
+        pending = getattr(self.transport, "pending_rollouts", None)
+        if pending is not None:
+            # absent attribute ≠ empty queue: a transport that can't report
+            # its backlog must not masquerade as a healthy one
+            self.telemetry.gauge("transport/queue_depth").set(float(pending))
+
     def train(
         self,
         num_steps: int,
@@ -657,14 +686,17 @@ class Learner:
             )
             step = self._host_step
             if step % cfg.log_every < stride:
-                # ONE transfer for the whole metrics dict.
-                scalars = {
-                    k: float(v) for k, v in jax.device_get(m).items()
-                }
-                if self.device_actor is not None:
-                    scalars.update(self.device_actor.drain_stats())
-                elif self.pool is not None:
-                    scalars.update(self.pool.drain_stats())
+                # ONE transfer for the whole metrics dict — and the ONLY
+                # host↔device sync the train loop ever performs (spans and
+                # gauges below are host wall-clock / host ints).
+                with self.telemetry.span("learner/metrics_fetch"):
+                    scalars = {
+                        k: float(v) for k, v in jax.device_get(m).items()
+                    }
+                    if self.device_actor is not None:
+                        scalars.update(self.device_actor.drain_stats())
+                    elif self.pool is not None:
+                        scalars.update(self.pool.drain_stats())
                 if self.league is not None:
                     self._flush_league_reports()
                     wrs = self.league.win_rates()
@@ -678,8 +710,8 @@ class Learner:
                 self._maybe_save_best(scalars)
                 if self._best_dir is not None:
                     scalars["best_win_rate"] = self._best_win
-                self._last_metrics = scalars
-                self.metrics.log(step, scalars)
+                self._publish_pipeline_gauges()
+                self._last_metrics = self.metrics.log(step, scalars)
             # `< stride` (not `== 0`): the counter advances in strides of
             # epochs_per_batch × steps_per_dispatch, which may step over
             # exact multiples.
@@ -827,7 +859,7 @@ class Learner:
             self.ckpt.wait()
         elapsed = time.time() - t_start
         actor_stats = self.pool.stats() if self.pool is not None else {}
-        return {
+        out = {
             **self._last_metrics,
             **{f"actor_{k}": v for k, v in actor_stats.items()},
             # Fresh end-of-run figures last so they win over logged snapshots.
@@ -836,6 +868,11 @@ class Learner:
             "frames_per_sec": frames_trained / max(elapsed, 1e-9),
             "elapsed_sec": elapsed,
         }
+        self._publish_pipeline_gauges()
+        # Close the machine-readable record with a final full snapshot (the
+        # end-of-run publish/checkpoint spans land here); console is spared.
+        self.metrics.log_files_only(self._host_step, out)
+        return out
 
 
 def main(argv=None) -> Dict[str, float]:
@@ -843,6 +880,13 @@ def main(argv=None) -> Dict[str, float]:
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--smoke", action="store_true", help="tiny fast config")
     p.add_argument("--logdir", type=str, default=None)
+    p.add_argument(
+        "--metrics-jsonl", type=str, default=None, metavar="PATH",
+        help="append every log-boundary metrics snapshot (training scalars "
+        "+ pipeline telemetry: per-stage spans, queue depth, staleness, "
+        "buffer occupancy) as JSON lines to PATH — the headless/bench "
+        "record; schema in docs/ARCHITECTURE.md 'Observability'",
+    )
     p.add_argument("--checkpoint-dir", type=str, default=None)
     p.add_argument("--restore", action="store_true")
     p.add_argument("--init-from", type=str, default=None, metavar="DIR",
@@ -1053,6 +1097,7 @@ def main(argv=None) -> Dict[str, float]:
         seed=args.seed,
         actor=args.actor or ("scalar" if args.no_vec else "device"),
         debug_checkify=args.checkify,
+        metrics_jsonl=args.metrics_jsonl,
     )
     from dotaclient_tpu.utils.profiling import trace
 

@@ -17,9 +17,11 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import List, Optional, Protocol
 
 from dotaclient_tpu.protos import dota_pb2 as pb
+from dotaclient_tpu.utils import telemetry
 
 
 class Transport(Protocol):
@@ -41,12 +43,17 @@ class InProcTransport:
     protos are passed by reference, never serialized to bytes.
     """
 
-    def __init__(self, max_rollouts: int = 4096) -> None:
+    def __init__(
+        self,
+        max_rollouts: int = 4096,
+        registry: Optional[telemetry.Registry] = None,
+    ) -> None:
         self._rollouts: "queue.Queue[pb.Rollout]" = queue.Queue(max_rollouts)
         self._publish_lock = threading.Lock()
         self._weights_lock = threading.Lock()
         self._weights: Optional[pb.ModelWeights] = None
         self.dropped = 0
+        self._tel = registry if registry is not None else telemetry.get_registry()
 
     def publish_rollout(self, rollout: pb.Rollout) -> None:
         # Actors must never block on a slow learner (the reference relies on
@@ -56,18 +63,25 @@ class InProcTransport:
             while True:
                 try:
                     self._rollouts.put_nowait(rollout)
-                    return
+                    break
                 except queue.Full:
                     try:
                         self._rollouts.get_nowait()
                         self.dropped += 1
+                        self._tel.counter("transport/experience_dropped").inc()
                     except queue.Empty:
                         pass
+        self._tel.counter("transport/experience_published").inc()
+        self._tel.gauge("transport/queue_depth").set(self._rollouts.qsize())
 
     def consume_rollouts(
         self, max_count: int, timeout: Optional[float] = None
     ) -> List[pb.Rollout]:
+        # timed explicitly, recorded only when something drained: a polling
+        # learner's empty 1 ms timeouts must not dominate the consume stage
+        # stats (they measure idle waiting, not drain cost)
         out: List[pb.Rollout] = []
+        t0 = time.perf_counter()
         try:
             out.append(self._rollouts.get(timeout=timeout))
         except queue.Empty:
@@ -77,11 +91,16 @@ class InProcTransport:
                 out.append(self._rollouts.get_nowait())
             except queue.Empty:
                 break
+        self._tel.timer("span/transport/consume").observe(time.perf_counter() - t0)
+        self._tel.counter("transport/experience_consumed").inc(len(out))
+        self._tel.gauge("transport/queue_depth").set(self._rollouts.qsize())
         return out
 
     def publish_weights(self, weights: pb.ModelWeights) -> None:
         with self._weights_lock:
             self._weights = weights
+        self._tel.counter("transport/weights_published").inc()
+        self._tel.gauge("transport/weights_version").set(weights.version)
 
     def latest_weights(self) -> Optional[pb.ModelWeights]:
         with self._weights_lock:
@@ -113,6 +132,7 @@ class AmqpTransport:
                 "broker); use InProcTransport in broker-less environments"
             ) from e
         self._pika = pika
+        self._tel = telemetry.get_registry()
         self._params = pika.ConnectionParameters(host=host, port=port)
         self._conn = pika.BlockingConnection(self._params)
         self._ch = self._conn.channel()
@@ -136,11 +156,13 @@ class AmqpTransport:
             routing_key=self.EXPERIENCE_QUEUE,
             body=bytes(payload),  # pika requires real bytes
         )
+        self._tel.counter("transport/experience_published").inc()
 
     def consume_rollouts(
         self, max_count: int, timeout: Optional[float] = None
     ) -> List[pb.Rollout]:  # pragma: no cover
         out: List[pb.Rollout] = []
+        t0 = time.perf_counter()
         for method, _props, body in self._ch.consume(
             self.EXPERIENCE_QUEUE, inactivity_timeout=timeout
         ):
@@ -153,6 +175,11 @@ class AmqpTransport:
             if len(out) >= max_count:
                 break
         self._ch.cancel()
+        if out:  # empty inactivity timeouts are idle waiting, not drain cost
+            self._tel.timer("span/transport/consume").observe(
+                time.perf_counter() - t0
+            )
+            self._tel.counter("transport/experience_consumed").inc(len(out))
         return out
 
     def publish_weights(self, weights: pb.ModelWeights) -> None:  # pragma: no cover
@@ -161,6 +188,15 @@ class AmqpTransport:
             routing_key="",
             body=weights.SerializeToString(),
         )
+        self._tel.counter("transport/weights_published").inc()
+        self._tel.gauge("transport/weights_version").set(weights.version)
+
+    @property
+    def pending_rollouts(self) -> int:  # pragma: no cover
+        """Broker-side experience backlog (one passive declare round trip —
+        read at log boundaries, not per step)."""
+        res = self._ch.queue_declare(queue=self.EXPERIENCE_QUEUE, passive=True)
+        return int(res.method.message_count)
 
     def latest_weights(self) -> Optional[pb.ModelWeights]:  # pragma: no cover
         latest: Optional[bytes] = None

@@ -31,9 +31,11 @@ import queue
 import socket
 import struct
 import threading
+import time
 from typing import List, Optional, Tuple
 
 from dotaclient_tpu.protos import dota_pb2 as pb
+from dotaclient_tpu.utils import telemetry
 
 _KIND_ROLLOUT = 0
 _KIND_WEIGHTS = 1
@@ -97,6 +99,7 @@ class TransportServer:
         self._weights_lock = threading.Lock()
         self._closed = threading.Event()
         self.dropped = 0
+        self._tel = telemetry.get_registry()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="transport-accept", daemon=True
         )
@@ -145,8 +148,15 @@ class TransportServer:
                         try:
                             self._rollouts.get_nowait()
                             self.dropped += 1
+                            self._tel.counter(
+                                "transport/experience_dropped"
+                            ).inc()
                         except queue.Empty:
                             pass
+                self._tel.counter("transport/experience_published").inc()
+                self._tel.gauge("transport/queue_depth").set(
+                    self._rollouts.qsize()
+                )
         except (OSError, ValueError):
             pass  # dead actor: stateless, just drop it (SURVEY.md §5.3)
         finally:
@@ -181,7 +191,10 @@ class TransportServer:
         raise RuntimeError("TransportServer is the learner side; actors publish")
 
     def _drain(self, max_count: int, timeout: Optional[float]) -> List[bytes]:
+        # timed explicitly, recorded only when something drained: empty poll
+        # timeouts measure idle waiting, not drain cost (see queues.py)
         out: List[bytes] = []
+        t0 = time.perf_counter()
         try:
             out.append(self._rollouts.get(timeout=timeout))
         except queue.Empty:
@@ -191,6 +204,9 @@ class TransportServer:
                 out.append(self._rollouts.get_nowait())
             except queue.Empty:
                 break
+        self._tel.timer("span/transport/consume").observe(time.perf_counter() - t0)
+        self._tel.counter("transport/experience_consumed").inc(len(out))
+        self._tel.gauge("transport/queue_depth").set(self._rollouts.qsize())
         return out
 
     def consume_rollouts(
@@ -230,6 +246,9 @@ class TransportServer:
             conns = list(self._conns)
         for conn in conns:
             self._locked_send(conn, _KIND_WEIGHTS, payload)
+        self._tel.counter("transport/weights_published").inc()
+        self._tel.gauge("transport/weights_version").set(weights.version)
+        self._tel.gauge("transport/actors_connected").set(self.n_connected)
 
     def latest_weights(self) -> Optional[pb.ModelWeights]:
         with self._weights_lock:

@@ -1,6 +1,7 @@
-"""Ops utilities: metrics, checkpointing, profiling, debug."""
+"""Ops utilities: metrics, telemetry, checkpointing, profiling, debug."""
 
+from dotaclient_tpu.utils import telemetry
 from dotaclient_tpu.utils.checkpoint import CheckpointManager
 from dotaclient_tpu.utils.metrics import MetricsLogger
 
-__all__ = ["CheckpointManager", "MetricsLogger"]
+__all__ = ["CheckpointManager", "MetricsLogger", "telemetry"]

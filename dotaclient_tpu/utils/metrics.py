@@ -1,10 +1,18 @@
-"""Host-side metrics emission: TensorBoard scalars + console.
+"""Host-side metrics emission — a thin facade over ``utils.telemetry``.
 
 Parity with the reference's tensorboardX scalar set — loss terms, entropy,
 reward components, rollout throughput, win-rate (SURVEY.md §5.5;
-reconstructed — the reference checkout was an empty mount). Metrics arrive as
-jit-returned device dicts; everything here is host-side and out of the hot
-path.
+reconstructed — the reference checkout was an empty mount) — extended with
+the pipeline telemetry registry: every ``log()`` merges the registry
+snapshot (per-stage spans, queue/staleness/occupancy gauges) into the
+emitted scalars, so the ``*_recent`` window-stat keys and the telemetry
+keys travel through the same sinks.
+
+Sinks: console (legacy short line — telemetry keys are elided there),
+tensorboardX when available (a missing install degrades to a warning, never
+a crash), and JSONL for headless/bench runs. Metrics arrive as jit-returned
+device dicts already fetched by the caller; everything here is host-side and
+out of the hot path.
 """
 
 from __future__ import annotations
@@ -14,29 +22,56 @@ from typing import Dict, Mapping, Optional
 
 import numpy as np
 
+from dotaclient_tpu.utils import telemetry
+
 
 class MetricsLogger:
-    def __init__(self, logdir: Optional[str] = None, console: bool = True) -> None:
-        self._writer = None
+    def __init__(
+        self,
+        logdir: Optional[str] = None,
+        console: bool = True,
+        jsonl: Optional[str] = None,
+        registry: Optional[telemetry.Registry] = None,
+    ) -> None:
+        self.registry = registry if registry is not None else telemetry.get_registry()
         self.console = console
-        if logdir is not None:
-            from tensorboardX import SummaryWriter
-
-            self._writer = SummaryWriter(logdir)
         self._t0 = time.time()
+        self._sinks = []
+        if console:
+            self._sinks.append(telemetry.ConsoleSink(self._t0))
+        if logdir is not None:
+            tb = telemetry.TensorBoardSink.create(logdir)
+            if tb is not None:
+                self._sinks.append(tb)
+        if jsonl is not None:
+            self._sinks.append(telemetry.JsonlSink(jsonl))
 
-    def log(self, step: int, scalars: Mapping[str, float], prefix: str = "") -> None:
-        flat: Dict[str, float] = {}
-        for k, v in scalars.items():
-            name = f"{prefix}{k}"
-            flat[name] = float(np.asarray(v))
-        if self._writer is not None:
-            for name, v in flat.items():
-                self._writer.add_scalar(name, v, step)
-        if self.console:
-            parts = " ".join(f"{k}={v:.4g}" for k, v in sorted(flat.items()))
-            print(f"[{time.time() - self._t0:8.1f}s] step {step}: {parts}", flush=True)
+    def log(
+        self, step: int, scalars: Mapping[str, float], prefix: str = ""
+    ) -> Dict[str, float]:
+        """Emit ``scalars`` plus the registry snapshot to every sink;
+        returns the merged flat dict (what a caller should retain as the
+        last-logged metrics)."""
+        flat = {f"{prefix}{k}": float(np.asarray(v)) for k, v in scalars.items()}
+        return self._emit(step, flat, console=True)
+
+    def log_files_only(
+        self, step: int, scalars: Mapping[str, float]
+    ) -> Dict[str, float]:
+        """Like :meth:`log` but skips the console sink — the end-of-run
+        snapshot that closes a JSONL record without spamming stdout."""
+        flat = {k: float(np.asarray(v)) for k, v in scalars.items()}
+        return self._emit(step, flat, console=False)
+
+    def _emit(
+        self, step: int, flat: Dict[str, float], console: bool
+    ) -> Dict[str, float]:
+        flat.update(self.registry.snapshot())
+        for sink in self._sinks:
+            if console or not isinstance(sink, telemetry.ConsoleSink):
+                sink.emit(step, flat)
+        return flat
 
     def close(self) -> None:
-        if self._writer is not None:
-            self._writer.close()
+        for sink in self._sinks:
+            sink.close()

@@ -1,9 +1,16 @@
 """Device tracing and debug-mode numerics checking.
 
 SURVEY.md §5.1-5.2: the reference had wall-clock prints and TensorBoard
-scalars only; the rebuild's observability is the JAX toolchain — profiler
-traces viewable in TensorBoard (tensorboard-plugin-profile) and
-`checkify`-instrumented train steps for NaN/Inf hunting.
+scalars only; the rebuild's observability is two complementary layers:
+
+* **Pipeline telemetry** (``utils/telemetry.py`` — see the "Observability"
+  section of docs/ARCHITECTURE.md): host-side per-stage spans, queue/
+  staleness/occupancy gauges, and counters across
+  actor→transport→buffer→learner, drained to console/JSONL/tensorboardX by
+  ``MetricsLogger``. That layer answers *which stage* is slow or starved.
+* **This module**: jax.profiler device traces viewable in TensorBoard
+  (tensorboard-plugin-profile) and `checkify`-instrumented train steps for
+  NaN/Inf hunting. This layer answers *why* a device stage is slow.
 
 Usage:
     with trace("runs/profile"):           # device trace of the block
@@ -11,6 +18,7 @@ Usage:
 
     python -m dotaclient_tpu.train.learner --profile runs/profile
     python -m dotaclient_tpu.train.learner --checkify   # debug numerics
+    python -m dotaclient_tpu.train.learner --metrics-jsonl run.jsonl  # spans
 """
 
 from __future__ import annotations

@@ -1,0 +1,351 @@
+"""Dependency-free pipeline telemetry: one registry, per-stage spans, sinks.
+
+The actor→transport→buffer→learner pipeline is only as fast as its slowest
+stage, and Podracer-style scaling work (PAPERS.md, arXiv:2104.06272) starts
+from per-stage throughput accounting; IMPACT (arXiv:1912.00167) adds that
+actor-side weight *staleness* must be tracked for async correctness. This
+module is the shared instrument panel: every layer records into one process-
+wide :class:`Registry`, and the learner's ``MetricsLogger`` (utils/metrics.py,
+now a facade over this registry) drains it to pluggable sinks.
+
+Primitives
+----------
+* :class:`Counter` — monotone count (``inc``); rates are derived by diffing
+  consecutive JSONL lines.
+* :class:`Gauge` — last-write-wins level (``set``): queue depth, buffer
+  occupancy, weight-version staleness.
+* :class:`Timer` — duration accumulator with EMA, mean, last, and an
+  approximate power-of-two-bucket histogram (``p95_s``).
+* ``Registry.span("stage")`` — context manager timing a pipeline stage into
+  the timer ``span/<stage>``; spans NEST via a per-thread stack
+  (``span("a")`` inside ``span("b")`` records ``span/b/a``).
+
+Everything here is host-side wall clock — recording a span never touches the
+device, so the learner's "no host↔device sync except at ``log_every``"
+discipline is preserved by construction.
+
+Snapshot key schema (the JSONL contract; see docs/ARCHITECTURE.md
+"Observability" and scripts/check_telemetry_schema.py):
+
+* counters / gauges: ``<name>`` → float value
+* timers: ``<name>/count``, ``/total_s``, ``/last_s``, ``/mean_s``,
+  ``/ema_s``, ``/p95_s``
+* spans are timers named ``span/<stage>``
+
+Pipeline stage names wired in this repo: ``actor/step``, ``actor/infer``,
+``actor/collect``, ``actor/drain``, ``transport/consume``,
+``transport/publish_weights``, ``buffer/insert``, ``buffer/sample``,
+``learner/consume``, ``learner/assemble``, ``learner/dispatch``,
+``learner/metrics_fetch``, ``league/evaluate``.
+
+Sinks: :class:`ConsoleSink` (prints only un-slashed legacy scalar keys, so
+log lines stay readable), :class:`JsonlSink` (one JSON object per emit —
+``{"ts", "step", "scalars"}`` — for headless/bench runs), and
+:class:`TensorBoardSink` (tensorboardX when available; degrades to a
+one-line warning when not).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import math
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, TextIO
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Timer",
+    "Registry",
+    "ConsoleSink",
+    "JsonlSink",
+    "TensorBoardSink",
+    "get_registry",
+]
+
+
+class Counter:
+    """Monotone counter. ``inc`` is the only mutator."""
+
+    __slots__ = ("value", "_lock")
+
+    def __init__(self) -> None:
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self.value += n
+
+
+class Gauge:
+    """Last-write-wins level (assignment is atomic under the GIL)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+
+# Histogram buckets: powers of two from 1 µs up; 36 buckets reach ~64 s,
+# far past any sane stage latency. Bucket i covers [2^i, 2^(i+1)) µs.
+_N_BUCKETS = 36
+_BUCKET0_S = 1e-6
+
+
+class Timer:
+    """Duration accumulator: count/total/last, EMA, approximate p95.
+
+    The EMA (alpha=0.2) is the responsive per-stage latency signal; the
+    histogram answers "was that spike real" without storing samples.
+    """
+
+    __slots__ = ("count", "total", "last", "ema", "_buckets", "_lock")
+
+    EMA_ALPHA = 0.2
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.total = 0.0
+        self.last = 0.0
+        self.ema = 0.0
+        self._buckets = [0] * _N_BUCKETS
+        self._lock = threading.Lock()
+
+    def observe(self, seconds: float) -> None:
+        seconds = max(float(seconds), 0.0)
+        with self._lock:
+            self.count += 1
+            self.total += seconds
+            self.last = seconds
+            self.ema = (
+                seconds
+                if self.count == 1
+                else self.EMA_ALPHA * seconds + (1 - self.EMA_ALPHA) * self.ema
+            )
+            if seconds > 0:
+                i = int(math.log2(max(seconds, _BUCKET0_S) / _BUCKET0_S))
+                self._buckets[min(max(i, 0), _N_BUCKETS - 1)] += 1
+            else:
+                self._buckets[0] += 1
+
+    @contextlib.contextmanager
+    def time(self) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(time.perf_counter() - t0)
+
+    def quantile(self, q: float) -> float:
+        """Approximate quantile from the bucket upper bounds (within 2× of
+        the true value — enough to separate 1 ms from 100 ms stalls)."""
+        with self._lock:
+            if not self.count:
+                return 0.0
+            target = q * self.count
+            seen = 0
+            for i, c in enumerate(self._buckets):
+                seen += c
+                if seen >= target:
+                    return _BUCKET0_S * (2.0 ** (i + 1))
+        return _BUCKET0_S * (2.0 ** _N_BUCKETS)
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            count, total, last, ema = self.count, self.total, self.last, self.ema
+        return {
+            "count": float(count),
+            "total_s": total,
+            "last_s": last,
+            "mean_s": total / count if count else 0.0,
+            "ema_s": ema,
+            "p95_s": self.quantile(0.95),
+        }
+
+
+class Registry:
+    """Named counters/gauges/timers plus the nesting ``span`` timer.
+
+    Create-or-get semantics: ``registry.counter("x")`` is cheap enough for
+    call sites to re-resolve by name every time — no handles to thread
+    through constructors. All mutation is thread-safe (the overlap-mode
+    actor thread and the learner thread share one registry).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._timers: Dict[str, Timer] = {}
+        self._span_stack = threading.local()
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter()
+            return c
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge()
+            return g
+
+    def timer(self, name: str) -> Timer:
+        with self._lock:
+            t = self._timers.get(name)
+            if t is None:
+                t = self._timers[name] = Timer()
+            return t
+
+    @contextlib.contextmanager
+    def span(self, stage: str) -> Iterator[None]:
+        """Time one pipeline stage into ``span/<stage>``.
+
+        A *bare* name (no "/") nests under the enclosing span via a
+        per-thread stack — ``span("b")`` inside ``span("a")`` records
+        ``span/a/b``. A name containing "/" is absolute: the documented
+        pipeline stages ("buffer/insert", "learner/dispatch", ...) keep
+        stable keys no matter which outer span the caller holds.
+        """
+        stack: List[str] = getattr(self._span_stack, "names", None) or []
+        if "/" in stage or not stack:
+            full = stage
+        else:
+            # stack entries are already full names — extend the innermost
+            full = f"{stack[-1]}/{stage}"
+        self._span_stack.names = stack + [full]
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.timer(f"span/{full}").observe(time.perf_counter() - t0)
+            self._span_stack.names = stack
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flatten every metric to ``name → float`` per the key schema."""
+        with self._lock:
+            counters = list(self._counters.items())
+            gauges = list(self._gauges.items())
+            timers = list(self._timers.items())
+        out: Dict[str, float] = {}
+        for name, c in counters:
+            out[name] = c.value
+        for name, g in gauges:
+            out[name] = g.value
+        for name, t in timers:
+            for stat, v in t.stats().items():
+                out[f"{name}/{stat}"] = v
+        return out
+
+    def clear(self) -> None:
+        """Drop every metric (test isolation)."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._timers.clear()
+
+
+# One process-wide registry: the pipeline layers (actor pools, transports,
+# buffer) self-instrument against it so telemetry needs zero constructor
+# plumbing; tests that want isolation construct their own Registry.
+_GLOBAL = Registry()
+
+
+def get_registry() -> Registry:
+    return _GLOBAL
+
+
+# -- sinks -------------------------------------------------------------------
+
+
+class ConsoleSink:
+    """The legacy console line: only un-slashed keys print (telemetry keys
+    all contain "/"), so per-step log lines stay the familiar short form."""
+
+    def __init__(self, t0: Optional[float] = None) -> None:
+        self._t0 = t0 if t0 is not None else time.time()
+
+    def emit(self, step: int, scalars: Dict[str, float]) -> None:
+        parts = " ".join(
+            f"{k}={v:.4g}" for k, v in sorted(scalars.items()) if "/" not in k
+        )
+        print(f"[{time.time() - self._t0:8.1f}s] step {step}: {parts}", flush=True)
+
+    def close(self) -> None:
+        pass
+
+
+def _json_safe(v: float) -> Optional[float]:
+    # NaN/Inf are not JSON; a diverged loss must not corrupt the stream.
+    return v if math.isfinite(v) else None
+
+
+class JsonlSink:
+    """Append one JSON object per emit: ``{"ts": <unix>, "step": <int>,
+    "scalars": {name: number|null}}`` — the machine-readable record for
+    headless/bench runs (non-finite values become null)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._f: Optional[TextIO] = open(path, "a")
+        self._lock = threading.Lock()
+
+    def emit(self, step: int, scalars: Dict[str, float]) -> None:
+        line = json.dumps(
+            {
+                "ts": time.time(),
+                "step": int(step),
+                "scalars": {k: _json_safe(float(v)) for k, v in scalars.items()},
+            },
+            sort_keys=True,
+        )
+        with self._lock:
+            if self._f is None:
+                return
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+class TensorBoardSink:
+    """tensorboardX scalars; construct via :meth:`create`, which degrades to
+    ``None`` with a one-line warning when tensorboardX is not installed
+    (console/JSONL sinks keep working — the logdir request must never crash
+    a training run in a slim image)."""
+
+    def __init__(self, writer) -> None:
+        self._writer = writer
+
+    @classmethod
+    def create(cls, logdir: str) -> Optional["TensorBoardSink"]:
+        try:
+            from tensorboardX import SummaryWriter
+        except ImportError:
+            print(
+                f"WARNING: tensorboardX not installed — logdir {logdir!r} "
+                f"ignored; continuing with console/JSONL sinks only",
+                flush=True,
+            )
+            return None
+        return cls(SummaryWriter(logdir))
+
+    def emit(self, step: int, scalars: Dict[str, float]) -> None:
+        for name, v in scalars.items():
+            self._writer.add_scalar(name, v, step)
+
+    def close(self) -> None:
+        self._writer.close()

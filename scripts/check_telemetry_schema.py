@@ -1,0 +1,145 @@
+"""CI guard: the learner's --metrics-jsonl output matches the documented schema.
+
+Runs a ``--smoke`` learner step with a JSONL sink attached (or validates an
+existing file via ``--path``) and checks:
+
+* every line parses as JSON and has the envelope
+  ``{"ts": float, "step": int >= 0, "scalars": {str: number|null}}``;
+* the union of scalar keys across lines covers the documented pipeline
+  telemetry contract (docs/ARCHITECTURE.md "Observability"): per-stage span
+  timings for the actor, buffer, transport, and learner stages, the
+  transport queue-depth gauge, the actor weight-version staleness gauge,
+  and the buffer occupancy gauge.
+
+Exit status 0 on success; 1 with a diagnostic on any violation. Invoked
+from the test suite (tests/test_telemetry.py), so tier-1 covers the schema.
+
+Usage:
+    python scripts/check_telemetry_schema.py            # run smoke + validate
+    python scripts/check_telemetry_schema.py --path x.jsonl   # validate only
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+from typing import Dict, List, Optional
+
+# Every key a --smoke run (device actor, in-proc transport, HBM buffer) must
+# emit. Timer stats are spot-checked through their /mean_s leaf; the other
+# leaves (count/total_s/last_s/ema_s/p95_s) share the emission path.
+REQUIRED_KEYS = (
+    # per-stage spans: actor → buffer → learner, + the transport publish
+    "span/actor/collect/mean_s",
+    "span/actor/drain/mean_s",
+    "span/buffer/insert/mean_s",
+    "span/buffer/sample/mean_s",
+    "span/learner/dispatch/mean_s",
+    "span/learner/metrics_fetch/mean_s",
+    "span/transport/publish_weights/mean_s",
+    # pipeline-health gauges
+    "transport/queue_depth",
+    "actor/weight_staleness",
+    "buffer/occupancy",
+    # throughput counters
+    "actor/frames_shipped",
+    "actor/rollouts_shipped",
+)
+
+TIMER_LEAVES = ("count", "total_s", "last_s", "mean_s", "ema_s", "p95_s")
+
+
+def validate_lines(lines: List[str]) -> List[str]:
+    """Return a list of violations (empty = schema holds)."""
+    errors: List[str] = []
+    union: Dict[str, object] = {}
+    if not lines:
+        return ["JSONL file is empty — no metrics were emitted"]
+    for i, raw in enumerate(lines, 1):
+        try:
+            obj = json.loads(raw)
+        except json.JSONDecodeError as e:
+            errors.append(f"line {i}: not valid JSON ({e})")
+            continue
+        if not isinstance(obj, dict):
+            errors.append(f"line {i}: top level is {type(obj).__name__}, not object")
+            continue
+        if not isinstance(obj.get("ts"), (int, float)):
+            errors.append(f"line {i}: missing/invalid 'ts'")
+        if not isinstance(obj.get("step"), int) or obj.get("step", -1) < 0:
+            errors.append(f"line {i}: missing/invalid 'step'")
+        scalars = obj.get("scalars")
+        if not isinstance(scalars, dict):
+            errors.append(f"line {i}: missing/invalid 'scalars'")
+            continue
+        for k, v in scalars.items():
+            if not isinstance(k, str):
+                errors.append(f"line {i}: non-string scalar key {k!r}")
+            elif v is not None and not isinstance(v, (int, float)):
+                errors.append(f"line {i}: scalar {k!r} is {type(v).__name__}")
+        union.update(scalars)
+    missing = [k for k in REQUIRED_KEYS if k not in union]
+    if missing:
+        errors.append(
+            "required telemetry keys never emitted: " + ", ".join(missing)
+        )
+    # every span timer must carry the full stat leaf set
+    span_roots = {
+        k.rsplit("/", 1)[0]
+        for k in union
+        if k.startswith("span/") and k.rsplit("/", 1)[1] in TIMER_LEAVES
+    }
+    for root in sorted(span_roots):
+        for leaf in TIMER_LEAVES:
+            if f"{root}/{leaf}" not in union:
+                errors.append(f"timer {root!r} missing stat leaf {leaf!r}")
+    return errors
+
+
+def run_smoke(path: str) -> None:
+    """One tiny learner run with the JSONL sink attached."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo_root not in sys.path:  # direct `python scripts/...` invocation
+        sys.path.insert(0, repo_root)
+    from dotaclient_tpu.train.learner import main as learner_main
+
+    learner_main(["--smoke", "--steps", "2", "--metrics-jsonl", path])
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument(
+        "--path", type=str, default=None,
+        help="validate an existing JSONL file instead of running the smoke",
+    )
+    args = p.parse_args(argv)
+
+    path = args.path
+    if path is None:
+        fd, path = tempfile.mkstemp(suffix=".jsonl", prefix="telemetry_schema_")
+        os.close(fd)
+        try:
+            run_smoke(path)
+            with open(path) as f:
+                lines = f.read().splitlines()
+        finally:
+            os.unlink(path)
+    else:
+        with open(path) as f:
+            lines = f.read().splitlines()
+
+    errors = validate_lines(lines)
+    if errors:
+        print("telemetry schema check FAILED:", file=sys.stderr)
+        for e in errors:
+            print(f"  - {e}", file=sys.stderr)
+        return 1
+    print(f"telemetry schema OK: {len(lines)} lines validated")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,308 @@
+"""Telemetry-core + pipeline-wiring tests (ISSUE 1: unified telemetry).
+
+Covers the registry primitives (counter/gauge/timer semantics, span
+nesting), the JSONL sink round-trip, MetricsLogger's graceful degrade
+without tensorboardX, the learner smoke run's staleness/queue-depth
+gauges, the documented JSONL schema (via scripts/check_telemetry_schema),
+and the sync discipline: telemetry must add ZERO host↔device syncs to the
+train loop (device fetches happen only at log_every boundaries).
+"""
+
+import dataclasses
+import importlib.util
+import json
+import os
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from dotaclient_tpu.config import RunConfig
+from dotaclient_tpu.utils import telemetry
+from dotaclient_tpu.utils.metrics import MetricsLogger
+
+
+def tiny_config(**over) -> RunConfig:
+    cfg = RunConfig()
+    return dataclasses.replace(
+        cfg,
+        env=dataclasses.replace(cfg.env, n_envs=2, max_dota_time=30.0),
+        ppo=dataclasses.replace(cfg.ppo, rollout_len=8, batch_rollouts=8),
+        buffer=dataclasses.replace(cfg.buffer, capacity_rollouts=32, min_fill=8),
+        checkpoint_every=10_000,
+        **over,
+    )
+
+
+class TestRegistry:
+    def test_counter_semantics(self):
+        r = telemetry.Registry()
+        r.counter("x").inc()
+        r.counter("x").inc(2.5)
+        assert r.snapshot()["x"] == pytest.approx(3.5)
+        # create-or-get: same object by name
+        assert r.counter("x") is r.counter("x")
+
+    def test_gauge_last_write_wins(self):
+        r = telemetry.Registry()
+        r.gauge("g").set(1.0)
+        r.gauge("g").set(7.0)
+        assert r.snapshot()["g"] == 7.0
+
+    def test_timer_stats(self):
+        r = telemetry.Registry()
+        t = r.timer("t")
+        t.observe(0.1)
+        t.observe(0.3)
+        snap = r.snapshot()
+        assert snap["t/count"] == 2
+        assert snap["t/total_s"] == pytest.approx(0.4)
+        assert snap["t/last_s"] == pytest.approx(0.3)
+        assert snap["t/mean_s"] == pytest.approx(0.2)
+        # EMA moves toward the last observation
+        assert 0.1 < snap["t/ema_s"] < 0.3
+        # approximate histogram quantile: within its 2x bucket bound
+        assert 0.15 <= snap["t/p95_s"] <= 0.8
+
+    def test_timer_time_contextmanager(self):
+        r = telemetry.Registry()
+        with r.timer("slept").time():
+            time.sleep(0.01)
+        assert r.snapshot()["slept/last_s"] >= 0.01
+
+    def test_span_records_and_nests(self):
+        r = telemetry.Registry()
+        with r.span("outer"):
+            time.sleep(0.002)
+            with r.span("inner"):
+                time.sleep(0.002)
+        snap = r.snapshot()
+        assert snap["span/outer/count"] == 1
+        assert snap["span/outer/inner/count"] == 1
+        # the outer span encloses the inner one
+        assert snap["span/outer/last_s"] >= snap["span/outer/inner/last_s"]
+
+    def test_span_nesting_depth_three(self):
+        """Regression: stack entries are full names — joining the whole
+        stack once duplicated prefixes ('span/a/a/b/c') at depth >= 3."""
+        r = telemetry.Registry()
+        with r.span("a"):
+            with r.span("b"):
+                with r.span("c"):
+                    pass
+        snap = r.snapshot()
+        assert snap["span/a/b/c/count"] == 1
+        assert "span/a/a/b/c/count" not in snap
+
+    def test_span_absolute_names_do_not_nest(self):
+        """Documented pipeline stages ('x/y' names) keep stable keys no
+        matter which enclosing span is active."""
+        r = telemetry.Registry()
+        with r.span("learner/step"):
+            with r.span("buffer/sample"):
+                pass
+        snap = r.snapshot()
+        assert "span/buffer/sample/count" in snap
+        assert "span/learner/step/buffer/sample/count" not in snap
+
+    def test_span_stack_unwinds_on_exception(self):
+        r = telemetry.Registry()
+        with pytest.raises(RuntimeError):
+            with r.span("boom"):
+                raise RuntimeError()
+        with r.span("after"):
+            pass
+        snap = r.snapshot()
+        assert snap["span/boom/count"] == 1
+        assert "span/after/count" in snap          # not nested under "boom"
+        assert "span/boom/after/count" not in snap
+
+    def test_clear(self):
+        r = telemetry.Registry()
+        r.counter("c").inc()
+        r.clear()
+        assert r.snapshot() == {}
+
+
+class TestSinks:
+    def test_jsonl_round_trip(self, tmp_path):
+        path = str(tmp_path / "m.jsonl")
+        r = telemetry.Registry()
+        r.gauge("depth").set(3.0)
+        with r.span("stage/one"):
+            pass
+        logger = MetricsLogger(console=False, jsonl=path, registry=r)
+        logger.log(1, {"loss": 0.25})
+        logger.log(2, {"loss": float("nan")})
+        logger.close()
+
+        lines = [json.loads(l) for l in open(path)]
+        assert len(lines) == 2
+        for ln in lines:
+            assert isinstance(ln["ts"], float)
+            assert isinstance(ln["step"], int)
+            assert isinstance(ln["scalars"], dict)
+        assert lines[0]["step"] == 1
+        assert lines[0]["scalars"]["loss"] == 0.25
+        assert lines[0]["scalars"]["depth"] == 3.0
+        assert lines[0]["scalars"]["span/stage/one/count"] == 1
+        # non-finite values must not corrupt the stream: encoded as null
+        assert lines[1]["scalars"]["loss"] is None
+
+    def test_console_elides_telemetry_keys(self, capsys):
+        r = telemetry.Registry()
+        r.gauge("transport/queue_depth").set(5.0)
+        logger = MetricsLogger(console=True, registry=r)
+        logger.log(3, {"loss": 0.5})
+        out = capsys.readouterr().out
+        assert "loss=0.5" in out
+        assert "queue_depth" not in out   # slashed keys are file-sink-only
+
+    def test_log_returns_merged_dict(self):
+        r = telemetry.Registry()
+        r.gauge("buffer/occupancy").set(9.0)
+        logger = MetricsLogger(console=False, registry=r)
+        flat = logger.log(0, {"loss": 1.0})
+        assert flat["loss"] == 1.0
+        assert flat["buffer/occupancy"] == 9.0
+
+    def test_metrics_logger_degrades_without_tensorboardx(self, monkeypatch, capsys):
+        """logdir=... must warn and continue when tensorboardX is missing —
+        never crash the run (ISSUE 1 satellite)."""
+        monkeypatch.setitem(sys.modules, "tensorboardX", None)
+        r = telemetry.Registry()
+        logger = MetricsLogger(logdir="/tmp/never_created_tb", console=False, registry=r)
+        assert "tensorboardX not installed" in capsys.readouterr().out
+        logger.log(1, {"loss": 0.1})   # still works through remaining sinks
+        logger.close()
+
+
+class TestLearnerTelemetry:
+    def test_smoke_run_emits_pipeline_gauges_and_spans(self, tmp_path):
+        """The acceptance contract: a tiny run's drained scalars carry the
+        staleness/queue-depth/occupancy gauges, and the JSONL record carries
+        per-stage span timings for every pipeline layer."""
+        from dotaclient_tpu.train.learner import Learner
+
+        path = str(tmp_path / "telemetry.jsonl")
+        learner = Learner(
+            tiny_config(log_every=1), metrics_jsonl=path
+        )  # vec actor (host pool): staleness accounting does real work
+        learner.train(2)
+
+        scalars = learner._last_metrics
+        assert "actor/weight_staleness" in scalars
+        assert "transport/queue_depth" in scalars
+        assert "buffer/occupancy" in scalars
+
+        lines = [json.loads(l) for l in open(path)]
+        assert lines, "no JSONL lines emitted"
+        union = {}
+        for ln in lines:
+            union.update(ln["scalars"])
+        for key in (
+            "span/actor/step/mean_s",
+            "span/actor/infer/mean_s",
+            "span/buffer/insert/mean_s",
+            "span/buffer/sample/mean_s",
+            "span/learner/consume/mean_s",
+            "span/learner/dispatch/mean_s",
+            "span/learner/metrics_fetch/mean_s",
+            "span/transport/publish_weights/mean_s",
+            "actor/weight_refresh_lag",
+            "buffer/batch_staleness",
+            "actor/frames_shipped",
+            "actor/rollouts_shipped",
+        ):
+            assert key in union, f"missing telemetry key {key}"
+        # dispatch timings are real (the train step ran)
+        assert union["span/learner/dispatch/count"] >= 2
+
+    def test_no_added_device_syncs_in_train_loop(self, monkeypatch):
+        """Telemetry must not break the sync discipline: with no log
+        boundary in range, the number of device fetches is INDEPENDENT of
+        how many optimizer steps run (fetches happen only at log_every
+        boundaries and at end-of-run drain)."""
+        from dotaclient_tpu.train.learner import Learner
+
+        learner = Learner(tiny_config(log_every=100_000), actor="device")
+        learner.train(1)   # compile + warm the pipeline
+
+        calls = {"n": 0}
+        real_device_get = jax.device_get
+
+        def counting_device_get(x):
+            calls["n"] += 1
+            return real_device_get(x)
+
+        monkeypatch.setattr(jax, "device_get", counting_device_get)
+        learner.train(2)
+        first = calls["n"]
+        calls["n"] = 0
+        learner.train(6)
+        second = calls["n"]
+        assert first == second, (
+            f"device fetches scale with steps ({first} vs {second}) — "
+            f"something inside the train loop is syncing"
+        )
+
+    def test_fetches_only_at_log_boundaries(self, monkeypatch):
+        """With log_every=1 every step is a boundary: fetch count grows by
+        exactly the per-boundary cost, pinning fetches TO the boundaries."""
+        from dotaclient_tpu.train.learner import Learner
+
+        learner = Learner(tiny_config(log_every=1), actor="device")
+        learner.train(1)
+
+        calls = {"n": 0}
+        real_device_get = jax.device_get
+
+        def counting_device_get(x):
+            calls["n"] += 1
+            return real_device_get(x)
+
+        monkeypatch.setattr(jax, "device_get", counting_device_get)
+        learner.train(2)
+        base = calls["n"]
+        calls["n"] = 0
+        learner.train(4)
+        assert calls["n"] - base == 2 * 2, (
+            "each extra optimizer step at log_every=1 should cost exactly "
+            "two fetches (metrics dict + stats drain)"
+        )
+
+
+class TestSchemaChecker:
+    @pytest.fixture()
+    def checker(self):
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "check_telemetry_schema",
+            os.path.join(root, "scripts", "check_telemetry_schema.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_rejects_malformed_lines(self, checker):
+        errors = checker.validate_lines(["not json"])
+        assert errors and "not valid JSON" in errors[0]
+        errors = checker.validate_lines(['{"ts": 1.0, "scalars": {}}'])
+        assert any("step" in e for e in errors)
+        errors = checker.validate_lines(
+            ['{"ts": 1.0, "step": 0, "scalars": {"x": "oops"}}']
+        )
+        assert any("'x'" in e for e in errors)
+
+    def test_rejects_missing_required_keys(self, checker):
+        errors = checker.validate_lines(['{"ts": 1.0, "step": 0, "scalars": {}}'])
+        assert any("required telemetry keys" in e for e in errors)
+
+    def test_smoke_run_passes_schema(self, checker, capsys):
+        """The CI guard end-to-end: a --smoke learner run with the JSONL
+        sink validates cleanly against the documented schema (tier-1
+        coverage for the acceptance criterion)."""
+        assert checker.main([]) == 0
+        assert "telemetry schema OK" in capsys.readouterr().out
