@@ -168,9 +168,18 @@ def main() -> None:
         last = json.loads(lines[-1])["scalars"] if lines else {}
         for label, key in (
             ("actor_collect_ema_s", "span/actor/collect/ema_s"),
+            ("buffer_stage_ema_s", "span/buffer/stage/ema_s"),
             ("buffer_insert_ema_s", "span/buffer/insert/ema_s"),
             ("buffer_sample_ema_s", "span/buffer/sample/ema_s"),
+            ("learner_assemble_ema_s", "span/learner/assemble/ema_s"),
             ("learner_dispatch_ema_s", "span/learner/dispatch/ema_s"),
+            # the pipelined-data-path proof (ISSUE 2): prefetch is the
+            # assemble work for batch N+1 issued while batch N's dispatch
+            # is in flight; overlap_fraction > 0 means the assemble cost
+            # is no longer serialized behind the dispatch
+            ("learner_prefetch_ema_s", "span/learner/prefetch/ema_s"),
+            ("prefetch_hit_rate", "learner/prefetch_hit_rate"),
+            ("overlap_fraction", "learner/overlap_fraction"),
             ("metrics_fetch_ema_s", "span/learner/metrics_fetch/ema_s"),
             ("buffer_occupancy", "buffer/occupancy"),
             ("queue_depth", "transport/queue_depth"),

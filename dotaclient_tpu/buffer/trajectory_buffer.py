@@ -18,6 +18,7 @@ single-writer discipline the reference gets from its one blocking consumer).
 
 from __future__ import annotations
 
+import logging
 from collections import deque
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
@@ -28,6 +29,8 @@ from jax.sharding import Mesh
 from dotaclient_tpu.config import RunConfig
 from dotaclient_tpu.train.ppo import example_batch
 from dotaclient_tpu.utils import telemetry
+
+logger = logging.getLogger(__name__)
 
 
 class TrajectoryBuffer:
@@ -88,6 +91,15 @@ class TrajectoryBuffer:
         # able to remove arbitrary slots, not just the head.
         self._order: Deque[int] = deque()
         self._free: List[int] = list(range(cap - 1, -1, -1))
+        # Held batches (prefetch lane): slots taken with ``hold=True`` are
+        # parked here — out of ``_order`` (cannot be re-taken or evicted by
+        # an interleaved ingest) and out of ``_free`` (cannot be
+        # overwritten) — until the consumer either ``release``s them
+        # (batch trained on) or ``requeue``s them (end-of-run flush: the
+        # experience returns to the front of the ring untrained, so a
+        # checkpoint loses nothing).
+        self._held: Dict[int, List[int]] = {}
+        self._next_ticket = 0
         self._warmed = False       # min_fill reached at least once
         # Per-slot producer version, host-side: staleness is re-checked at
         # consume time too — a rollout that was fresh at ingest can go stale
@@ -106,6 +118,16 @@ class TrajectoryBuffer:
             (x.shape[1:], np.dtype(x.dtype)) for x in jax.tree.leaves(template)
         ]
         self._skew_warned = False
+        # Host staging lanes (BufferConfig.staging_slots): the ingest path
+        # copies decoded rows into one of these REUSED preallocated numpy
+        # buffers instead of np.stack-allocating per call, rotating lanes so
+        # the scatter issued for ingest N (async dispatch may still read the
+        # host rows) never shares a lane with ingest N+1's assembly.
+        # Allocated lazily at first host-path ingest — the device-rollout
+        # path scatters device chunks and never stages host rows.
+        self._staging_lanes = max(1, config.buffer.staging_slots)
+        self._staging: Optional[List[Any]] = None
+        self._staging_idx = 0
 
         self._scatter = jax.jit(
             lambda store, rows, idx: jax.tree.map(
@@ -151,14 +173,17 @@ class TrajectoryBuffer:
                 continue
             if not self._matches_slot(arrays):
                 self.dropped_skew += 1
+                # Counted (rates come from diffing JSONL lines) AND logged —
+                # never a bare print: headless runs must see the skew in
+                # both the log stream and the telemetry record.
+                self._tel.counter("buffer/skew_drops_total").inc()
                 if not self._skew_warned:
                     self._skew_warned = True
-                    print(
+                    logger.warning(
                         "trajectory_buffer: dropping rollout whose shapes do "
                         "not match this learner's config (actor running a "
                         "different rollout_len/obs/model config?) — align "
-                        "actor and learner configs",
-                        flush=True,
+                        "actor and learner configs"
                     )
                 continue
             fresh.append((meta, arrays))
@@ -172,18 +197,15 @@ class TrajectoryBuffer:
             return 0
 
         with self._tel.span("buffer/insert"):
-            rows = jax.tree.map(
-                lambda *xs: np.stack(xs), *[arrays for _, arrays in fresh]
-            )
-            # Allocate slots: free ones first, then evict oldest unconsumed.
-            slots = []
-            for _ in fresh:
-                if self._free:
-                    slots.append(self._free.pop())
-                else:
-                    slots.append(self._order.popleft())
-                    self.dropped_overflow += 1
-            idx = np.asarray(slots, dtype=np.int32)
+            rows = self._stage_rows([arrays for _, arrays in fresh])
+            slots = self._alloc_slots(len(fresh))
+            if len(slots) < len(fresh):
+                fresh = fresh[: len(slots)]
+                rows = jax.tree.map(lambda r: r[: len(slots)], rows)
+                if not fresh:
+                    self._publish_telemetry()
+                    return 0
+            idx = np.asarray(slots, dtype=np.int32)   # host-sync-ok: host ints
             # Scatter in power-of-two chunks (binary decomposition of the
             # ingest count): a varying leading dim would compile one XLA
             # program per distinct count — up to `capacity` of them (ADVICE
@@ -220,6 +242,61 @@ class TrajectoryBuffer:
         except (TypeError, ValueError, AttributeError):
             return False
 
+    def _alloc_slots(self, n: int) -> List[int]:
+        """Allocate up to ``n`` writable slots for an ingest scatter: free
+        slots first, then evict oldest unconsumed (counted in
+        ``dropped_overflow``). Held (in-flight prefetched) slots are in
+        neither pool — they can be neither evicted nor overwritten — so
+        when everything else is exhausted the remainder is dropped
+        (counted) rather than corrupting a batch mid-consumption. The
+        returned list may be shorter than ``n``."""
+        slots: List[int] = []
+        for k in range(n):
+            if self._free:
+                slots.append(self._free.pop())
+            elif self._order:
+                slots.append(self._order.popleft())
+                self.dropped_overflow += 1
+            else:
+                self.dropped_overflow += n - k
+                break
+        return slots
+
+    def _stage_rows(self, arrays_list: List[Any]) -> Any:
+        """Copy decoded rollout rows into the next staging lane and return
+        per-leaf views of the first ``len(arrays_list)`` rows.
+
+        The lanes are preallocated at ring capacity (the most one ``add``
+        can ingest) and REUSED round-robin: no per-ingest allocation, and
+        the ``staging_slots``-deep rotation guarantees the rows a possibly
+        still-in-flight previous scatter reads are never overwritten by the
+        current assembly — the double-buffering that lets the learner issue
+        batch N+1's ingest while batch N's epoch step runs.
+        """
+        if self._staging is None:
+            leaves_per_lane = [
+                [
+                    np.empty((self.capacity,) + shape, dtype)
+                    for shape, dtype in self._tmpl_leaves
+                ]
+                for _ in range(self._staging_lanes)
+            ]
+            self._staging = [
+                jax.tree.unflatten(self._tmpl_struct, leaves)
+                for leaves in leaves_per_lane
+            ]
+        lane = self._staging[self._staging_idx]
+        self._staging_idx = (self._staging_idx + 1) % self._staging_lanes
+        n = len(arrays_list)
+        with self._tel.span("buffer/stage"):
+            dst_leaves = jax.tree.leaves(lane)
+            for i, arrays in enumerate(arrays_list):
+                # leaf order matches the template: _matches_slot already
+                # verified the pytree structure at the ingest door
+                for dst, src in zip(dst_leaves, jax.tree.leaves(arrays)):
+                    dst[i] = src
+        return jax.tree.map(lambda dst: dst[:n], lane)
+
     def add_device(self, chunk: Dict[str, Any], version: int) -> int:
         """Ingest a device-resident chunk batch (arrays ``[L, T, ...]``, the
         on-device rollout path) — device-to-device scatter, no host copy of
@@ -234,14 +311,12 @@ class TrajectoryBuffer:
             take = min(L, self.capacity)
             if take < L:
                 self.dropped_overflow += L - take
-            slots = []
-            for _ in range(take):
-                if self._free:
-                    slots.append(self._free.pop())
-                else:
-                    slots.append(self._order.popleft())
-                    self.dropped_overflow += 1
-            idx = np.asarray(slots, dtype=np.int32)
+            slots = self._alloc_slots(take)
+            take = len(slots)
+            if not take:
+                self._publish_telemetry()
+                return 0
+            idx = np.asarray(slots, dtype=np.int32)   # host-sync-ok: host ints
             pos = 0
             remaining = take
             while remaining:
@@ -262,7 +337,8 @@ class TrajectoryBuffer:
         self,
         batch_size: Optional[int] = None,
         current_version: Optional[int] = None,
-    ) -> Optional[Dict[str, Any]]:
+        hold: bool = False,
+    ) -> Optional[Any]:
         """Consume the oldest ``batch_size`` rollouts as a train batch
         (device arrays, batch-sharded). Returns None if underfilled, or
         before ``min_fill`` has been reached for the first time (warmup
@@ -272,6 +348,13 @@ class TrajectoryBuffer:
         every unconsumed slot whose producer version has fallen more than
         ``max_staleness`` behind is dropped (slots are scanned, not just the
         head — ship order does not imply version order).
+
+        With ``hold=True`` (the prefetch lane) the return is ``(batch,
+        ticket)`` and the slots are PARKED instead of freed: an interleaved
+        ingest can neither evict nor overwrite them while the batch is in
+        flight. The consumer must then call :meth:`release` (trained on) or
+        :meth:`requeue` (flushed untrained — the rows go back to the front
+        of the ring, so checkpoints lose nothing).
         """
         b = batch_size or self.config.ppo.batch_rollouts
         if current_version is not None:
@@ -294,18 +377,44 @@ class TrajectoryBuffer:
         if self.size < b:
             return None
         with self._tel.span("buffer/sample"):
-            idx = np.asarray([self._order.popleft() for _ in range(b)], np.int32)
+            idx = np.asarray(   # host-sync-ok: host ints
+                [self._order.popleft() for _ in range(b)], np.int32
+            )
             batch = self._gather(self._store, idx)
-            self._free.extend(int(s) for s in idx)
+            if hold:
+                ticket = self._next_ticket
+                self._next_ticket += 1
+                self._held[ticket] = [int(s) for s in idx]
+            else:
+                self._free.extend(int(s) for s in idx)
         if current_version is not None:
             # host-side ints: how far behind the optimizer the experience in
             # this batch is, in optimizer steps (the IMPACT-style staleness
             # signal the --overlap path needs; 0 on the on-device path)
             self._tel.gauge("buffer/batch_staleness").set(
-                float(current_version - self._slot_version[idx].mean())
+                float(current_version - self._slot_version[idx].mean())   # host-sync-ok: host ints
             )
         self._publish_telemetry()
-        return batch
+        return (batch, ticket) if hold else batch
+
+    def release(self, ticket: int) -> None:
+        """The held batch was consumed — its slots become reusable.
+        Tolerates an already-cleared ticket (a ``state_dict`` snapshot may
+        have folded held slots back via :meth:`requeue_all_held`)."""
+        self._free.extend(self._held.pop(ticket, ()))
+
+    def requeue(self, ticket: int) -> None:
+        """The held batch was NOT consumed (end-of-run flush): its slots
+        return to the FRONT of the consumption order, in their original
+        relative order — the next ``take`` re-gathers the same rows."""
+        self._order.extendleft(reversed(self._held.pop(ticket, ())))
+
+    def requeue_all_held(self) -> None:
+        """Defensive checkpoint hook: park nothing across a state_dict —
+        newest tickets first, so the oldest held batch ends up at the very
+        front and global FIFO order is preserved."""
+        for ticket in sorted(self._held, reverse=True):
+            self.requeue(ticket)
 
     # -- checkpointing -----------------------------------------------------
 
@@ -319,6 +428,9 @@ class TrajectoryBuffer:
             out[: len(vals)] = list(vals)
             return out
 
+        # in-flight held batches are unconsumed experience: fold them back
+        # into the order so the snapshot is self-contained
+        self.requeue_all_held()
         return {
             "store": jax.tree.map(np.asarray, self._store),
             "order": padded(self._order),
@@ -328,6 +440,7 @@ class TrajectoryBuffer:
                 [
                     int(self._warmed), self.dropped_stale,
                     self.dropped_overflow, self.ingested,
+                    self.dropped_skew,
                 ],
                 np.int64,
             ),
@@ -342,14 +455,18 @@ class TrajectoryBuffer:
             int(s) for s in np.asarray(state["order"]) if s >= 0
         )
         self._free = [int(s) for s in np.asarray(state["free"]) if s >= 0]
+        self._held = {}   # snapshots never carry in-flight holds
         self._slot_version = np.asarray(state["slot_version"]).copy()
-        warmed, stale, overflow, ingested = (
-            int(v) for v in np.asarray(state["counters"])
-        )
+        counters = [int(v) for v in np.asarray(state["counters"])]
+        # snapshots written before dropped_skew joined the array carry 4
+        # entries; missing counters resume at 0
+        counters += [0] * (5 - len(counters))
+        warmed, stale, overflow, ingested, skew = counters[:5]
         self._warmed = bool(warmed)
         self.dropped_stale = stale
         self.dropped_overflow = overflow
         self.ingested = ingested
+        self.dropped_skew = skew
 
     def _publish_telemetry(self) -> None:
         """Mirror the host-side bookkeeping into the registry (gauges are

@@ -143,6 +143,16 @@ class PPOConfig:
     kl_lr_up: float = 1.02
     kl_lr_min_scale: float = 0.01
     kl_lr_max_scale: float = 10.0
+    # Fused epoch step (train/ppo.make_epoch_step): when a consumed batch
+    # needs more than one optimizer step (epochs_per_batch × minibatches >
+    # 1), run ALL of them inside one donated XLA program — a lax.scan over
+    # minibatch slices of the epoch permutations — instead of the staged
+    # host loop's gather+step dispatch pair per minibatch. Same updates on
+    # the same data (the permutations come from the same seeded stream as
+    # the staged fallback; agreement to XLA-fusion float rounding); the
+    # staged path remains for --checkify and as the explicit opt-out.
+    # False forces the staged loop.
+    fused_epoch: bool = True
 
     @property
     def steps_per_batch(self) -> int:
@@ -208,6 +218,18 @@ class MeshConfig:
 class BufferConfig:
     capacity_rollouts: int = 256   # ring-buffer slots (sharded over data axis)
     min_fill: int = 32             # rollouts required before first train step
+    # Host staging lanes for ingest: decoded rollout rows are copied into
+    # one of this many REUSED preallocated numpy buffers (rotating) before
+    # the device scatter, instead of a fresh np.stack allocation per ingest.
+    # 2 = double buffering: the scatter for ingest N can still be in flight
+    # (async dispatch holds the host rows) while ingest N+1 assembles into
+    # the other lane. 1 disables the overlap margin but keeps the reuse.
+    staging_slots: int = 2
+    # Transport-consume poll timeout (seconds) for the learner's ingest
+    # drain — how long an empty poll blocks before the loop moves on. A
+    # batch already assembled in the prefetch lane is consumed without
+    # reaching the drain at all (train/learner.py `_next_batch`).
+    consume_poll_timeout_s: float = 0.001
 
 
 @dataclasses.dataclass(frozen=True)

@@ -6,6 +6,7 @@ from dotaclient_tpu.train.ppo import (
     TrainState,
     example_batch,
     init_train_state,
+    make_epoch_step,
     make_optimizer,
     make_train_step,
     ppo_loss,
@@ -18,6 +19,7 @@ __all__ = [
     "gae",
     "gae_reference",
     "init_train_state",
+    "make_epoch_step",
     "make_optimizer",
     "make_train_step",
     "ppo_loss",

@@ -19,7 +19,15 @@ device value per step — step/version counters are host-side mirrors, the
 donated train step is dispatch-only, and metrics are fetched (one transfer)
 only at ``log_every`` boundaries. On hardware where a host↔device round trip
 is expensive this is the difference between dispatch-rate and sync-rate
-training.
+training. ``scripts/check_host_sync.py`` guards the discipline statically.
+
+Pipelined data path (ISSUE 2, docs/ARCHITECTURE.md "Pipelined data path"):
+multi-epoch/minibatch batches train through the fused epoch step — ONE
+donated dispatch for all ``epochs × minibatches`` updates
+(``ppo.fused_epoch``; ``train/ppo.make_epoch_step``) — and the loop
+prefetches batch N+1 (transport drain → staged host rows → ring scatter →
+batch gather, all dispatch) behind batch N's in-flight step, with hit-rate
+and overlap-fraction gauges proving the overlap.
 
 Usage:
     python -m dotaclient_tpu.train.learner --smoke       # tiny sanity run
@@ -48,7 +56,11 @@ from dotaclient_tpu.config import RunConfig, default_config
 from dotaclient_tpu.actor import ActorPool, VecActorPool
 from dotaclient_tpu.models import init_params, make_policy
 from dotaclient_tpu.parallel import make_mesh
-from dotaclient_tpu.train.ppo import init_train_state, make_train_step
+from dotaclient_tpu.train.ppo import (
+    init_train_state,
+    make_epoch_step,
+    make_train_step,
+)
 from dotaclient_tpu.transport import (
     InProcTransport,
     Transport,
@@ -294,6 +306,22 @@ class Learner:
             self.policy, config, self.mesh, debug_checkify=debug_checkify,
             anchor_params=self.anchor_params,
         )
+        # Fused epoch step (ppo.fused_epoch): when one consumed batch needs
+        # E×M > 1 optimizer steps, run them all in ONE donated program
+        # instead of the staged gather+step dispatch pair per minibatch.
+        # The staged loop stays compiled-on-demand as the fallback
+        # (--checkify instruments per-step; fused_epoch=false opts out).
+        self.epoch_step = None
+        if (
+            config.ppo.fused_epoch
+            and config.ppo.steps_per_batch > 1
+            and mode != "fused"
+            and not debug_checkify
+        ):
+            self.epoch_step = make_epoch_step(
+                self.policy, config, self.mesh,
+                anchor_params=self.anchor_params,
+            )
         # Fused mode trains each chunk inside its one program and never
         # stages experience: allocating the HBM ring there would pin
         # capacity_rollouts chunks of dead device memory.
@@ -396,10 +424,24 @@ class Learner:
         self._mb_draws = 0          # permutations consumed (for exact resume)
         self._steps_per_batch = config.ppo.steps_per_batch
         self._last_metrics: Dict[str, float] = {}
+        # Prefetch lane: batch N+1, already drained/scattered/gathered while
+        # batch N's (dispatch-only) optimizer step runs on the device. Hit
+        # and overlap accounting feed the learner/prefetch_hit_rate and
+        # learner/overlap_fraction gauges — host floats, no device traffic.
+        self._prefetched = None
+        self._prefetch_ticket: Optional[int] = None
+        self._prefetch_hits = 0
+        self._prefetch_misses = 0
+        self._prefetch_overlapped_s = 0.0
+        self._prefetch_serial_s = 0.0
+        # True between an optimizer dispatch and the next blocking fetch:
+        # host work done in that window overlaps device compute.
+        self._dispatch_inflight = False
+        self._poll_timeout = config.buffer.consume_poll_timeout_s
         # Host-side mirrors of state.step/state.version: reading the device
         # scalars costs a full sync per read, so the loop never does.
-        self._host_step = int(np.asarray(self.state.step))
-        self._host_version = int(np.asarray(self.state.version))
+        self._host_step = int(np.asarray(self.state.step))   # host-sync-ok: one-time init
+        self._host_version = int(np.asarray(self.state.version))   # host-sync-ok: one-time init
         # Pipeline restore (buffer contents + device-actor state) happens
         # after those components exist; weights/opt-state restored above.
         if (
@@ -424,16 +466,20 @@ class Learner:
             if not rollouts:
                 return 0
             return self.buffer.add(rollouts, self._host_version)
+        # Poll budget (buffer.consume_poll_timeout_s): how long an EMPTY
+        # drain may block. A ready prefetched batch never waits on this —
+        # _next_batch serves the lane without reaching the drain at all.
+        timeout = self._poll_timeout
         if hasattr(self.transport, "consume_decoded"):
             # socket path: raw bytes → native wire parser → zero-copy views
             rollouts = self.transport.consume_decoded(
-                self.config.buffer.capacity_rollouts, timeout=0.001
+                self.config.buffer.capacity_rollouts, timeout=timeout
             )
             if not rollouts:
                 return 0
             return self.buffer.add(rollouts, self._host_version)
         protos = self.transport.consume_rollouts(
-            self.config.buffer.capacity_rollouts, timeout=0.001
+            self.config.buffer.capacity_rollouts, timeout=timeout
         )
         if not protos:
             return 0
@@ -445,13 +491,44 @@ class Learner:
         """Run ``epochs_per_batch`` passes over one batch, each split into
         ``minibatches`` shuffled slices (the standard PPO regime; with the
         defaults of 1×1 this is a single donated step). Dispatch-only.
-        Returns the last pass's (device-resident) metrics."""
+        Returns the last pass's (device-resident) metrics.
+
+        With ``ppo.fused_epoch`` (the default) and E×M > 1 this is ONE
+        donated dispatch: the epoch permutations are drawn host-side from
+        the same ``_mb_rng`` stream the staged loop uses (same updates on
+        the same data, and ``_mb_draws`` keeps its exact-resume meaning —
+        one draw per epoch), then the whole update loop runs in-program
+        (``make_epoch_step``).
+        The staged loop below is the fallback for --checkify and
+        ``fused_epoch=false``.
+        """
         cfg = self.config.ppo
         M = max(1, cfg.minibatches)
-        for _ in range(cfg.epochs_per_batch):
+        E = cfg.epochs_per_batch
+        if self.epoch_step is not None:
+            B = cfg.batch_rollouts
+            if M > 1:
+                perms = np.stack(
+                    [self._mb_rng.permutation(B) for _ in range(E)]
+                )
+                self._mb_draws += E
+            else:
+                # unsplit batches are never shuffled (matches the staged
+                # path); the in-program scan ignores this placeholder
+                perms = np.broadcast_to(np.arange(B), (E, B))
+            with self.telemetry.span("learner/dispatch"):
+                self.state, m = self.epoch_step(
+                    self.state, batch, perms.astype(np.int32)
+                )
+            self._dispatch_inflight = True
+            self._host_step += E * M
+            self._host_version += E * M
+            return m
+        for _ in range(E):
             if M == 1:
                 with self.telemetry.span("learner/dispatch"):
                     self.state, m = self.train_step(self.state, batch)
+                self._dispatch_inflight = True
                 self._host_step += 1
                 self._host_version += 1
                 continue
@@ -465,9 +542,72 @@ class Learner:
                     sub = self._minibatch_gather(batch, idx)
                 with self.telemetry.span("learner/dispatch"):
                     self.state, m = self.train_step(self.state, sub)
+                self._dispatch_inflight = True
                 self._host_step += 1
                 self._host_version += 1
         return m
+
+    def _next_batch(self, drain_transport: bool = True):
+        """The consume side of the prefetch lane: hand back the batch
+        staged behind the previous dispatch if there is one, else do the
+        (serial) ingest+take now. Dispatch-only either way."""
+        batch, self._prefetched = self._prefetched, None
+        if batch is not None:
+            # consuming the held batch: its ring slots become reusable
+            self.buffer.release(self._prefetch_ticket)
+            self._prefetch_ticket = None
+            self._prefetch_hits += 1
+            return batch
+        t0 = time.perf_counter()
+        if drain_transport:
+            self.ingest()
+        batch = self.buffer.take(current_version=self._host_version)
+        if batch is not None:
+            # only productive staging counts toward the overlap accounting
+            # — empty polls while starved are idle waiting, not assemble
+            # cost (same rule the transport/consume span applies)
+            self._prefetch_serial_s += time.perf_counter() - t0
+            self._prefetch_misses += 1
+        return batch
+
+    def _prefetch_next(self, drain_transport: bool = True) -> None:
+        """Stage batch N+1 while batch N's optimizer step is still in
+        flight: the loop is dispatch-only, so the host returns from
+        ``_optimize`` immediately and the transport drain, host-row
+        staging, ring scatter, and batch gather issued here all overlap
+        the device's epoch-step compute. Single-writer discipline holds —
+        this runs on the learner thread, same as every other buffer op."""
+        if self._prefetched is not None or self.buffer is None:
+            return
+        t0 = time.perf_counter()
+        if drain_transport:
+            self.ingest()
+        # hold=True parks the slots: an ingest racing this in-flight
+        # batch can neither evict nor overwrite them
+        taken = self.buffer.take(
+            current_version=self._host_version, hold=True
+        )
+        if taken is None:
+            return   # nothing staged: idle waiting, not assemble cost
+        self._prefetched, self._prefetch_ticket = taken
+        dt = time.perf_counter() - t0
+        # recorded only when a batch was actually staged, like the
+        # transport/consume span — empty attempts would dilute both the
+        # span stats and the overlap fraction toward meaninglessness
+        self.telemetry.timer("span/learner/prefetch").observe(dt)
+        if self._dispatch_inflight:
+            self._prefetch_overlapped_s += dt
+        else:
+            self._prefetch_serial_s += dt
+
+    def _flush_prefetch(self) -> None:
+        """Return an unconsumed prefetched batch to the ring (front of the
+        order) before anything that snapshots or ends the run — prefetching
+        must never turn into experience loss."""
+        if self._prefetched is not None:
+            self.buffer.requeue(self._prefetch_ticket)
+            self._prefetched = None
+            self._prefetch_ticket = None
 
     def _actor_params_copy(self):
         """Device-to-device copy of the current params for the actor pool:
@@ -481,6 +621,9 @@ class Learner:
         full device state — sim worlds, recurrent carries, PRNG, episode
         accumulators — as flat leaves (checkpoint-format-stable regardless
         of the NamedTuple nesting)."""
+        # an in-flight prefetched batch goes back to the ring first: the
+        # snapshot must carry every unconsumed rollout
+        self._flush_prefetch()
         out: Dict[str, Any] = (
             {"buffer": self.buffer.state_dict()} if self.buffer else {}
         )
@@ -649,6 +792,20 @@ class Learner:
             # absent attribute ≠ empty queue: a transport that can't report
             # its backlog must not masquerade as a healthy one
             self.telemetry.gauge("transport/queue_depth").set(float(pending))
+        # Prefetch-lane health: hit rate (batches served from the lane /
+        # batches served at all) and overlap fraction (prefetch host time
+        # spent while a dispatch was in flight / all prefetch host time) —
+        # the proof the data path actually pipelines.
+        served = self._prefetch_hits + self._prefetch_misses
+        if served:
+            self.telemetry.gauge("learner/prefetch_hit_rate").set(
+                self._prefetch_hits / served
+            )
+        staged = self._prefetch_overlapped_s + self._prefetch_serial_s
+        if staged > 0:
+            self.telemetry.gauge("learner/overlap_fraction").set(
+                self._prefetch_overlapped_s / staged
+            )
 
     def train(
         self,
@@ -691,18 +848,21 @@ class Learner:
                 # gauges below are host wall-clock / host ints).
                 with self.telemetry.span("learner/metrics_fetch"):
                     scalars = {
-                        k: float(v) for k, v in jax.device_get(m).items()
+                        k: float(v) for k, v in jax.device_get(m).items()   # host-sync-ok: log_every boundary
                     }
                     if self.device_actor is not None:
                         scalars.update(self.device_actor.drain_stats())
                     elif self.pool is not None:
                         scalars.update(self.pool.drain_stats())
+                # the fetch blocked on the dispatched step — overlap window
+                # for prefetch accounting closes here
+                self._dispatch_inflight = False
                 if self.league is not None:
                     self._flush_league_reports()
                     wrs = self.league.win_rates()
-                    scalars["league_snapshots"] = float(len(wrs))
+                    scalars["league_snapshots"] = float(len(wrs))   # host-sync-ok: host ints
                     if wrs:
-                        scalars["league_winrate_mean"] = float(np.mean(wrs))
+                        scalars["league_winrate_mean"] = float(np.mean(wrs))   # host-sync-ok: host floats
                 if self.buffer is not None:
                     scalars.update(self.buffer.metrics())
                 elapsed = time.time() - t_start
@@ -749,6 +909,9 @@ class Learner:
             # On-device rollout mode: collect→ingest→train is all dispatch
             # (the device serializes rollout and train programs back-to-back,
             # so a host thread would add nothing; `overlap` is a no-op here).
+            # The prefetch lane still earns its keep: batch N+1's gather is
+            # issued behind batch N's epoch step, so the host-side take/
+            # bookkeeping cost never sits between two dispatches.
             da = self.device_actor
             while steps_done < num_steps:
                 opp_params, opp_idx = self._league_opponent()
@@ -758,26 +921,32 @@ class Learner:
                 self._report_league(opp_idx, chunk_stats)
                 self.buffer.add_device(chunk, self._host_version)
                 while (
-                    batch := self.buffer.take(
-                        current_version=self._host_version
-                    )
+                    batch := self._next_batch(drain_transport=False)
                 ) is not None:
                     m = self._optimize(batch)
+                    if steps_done + epochs < num_steps:
+                        # there is a next step to feed; a batch staged
+                        # behind the FINAL dispatch could never be consumed
+                        # and would only be requeued at the flush below
+                        self._prefetch_next(drain_transport=False)
                     steps_done += epochs
                     after_step(m)
                     if steps_done >= num_steps:
                         break
         elif self.actor_mode == "external":
             # Experience arrives from standalone actor processes over the
-            # transport; this loop only trains and publishes weights.
+            # transport; this loop only trains and publishes weights. The
+            # transport drain + host-row staging + scatter + gather for
+            # batch N+1 run behind batch N's dispatched step (prefetch).
             self._publish_weights()
             while steps_done < num_steps:
-                self.ingest()
-                batch = self.buffer.take(current_version=self._host_version)
+                batch = self._next_batch()
                 if batch is None:
                     time.sleep(0.005)
                     continue
                 m = self._optimize(batch)
+                if steps_done + epochs < num_steps:   # see device loop
+                    self._prefetch_next()
                 steps_done += epochs
                 after_step(m)
                 if refresh_every and (steps_done // epochs) % refresh_every == 0:
@@ -804,14 +973,13 @@ class Learner:
                         raise RuntimeError(
                             "actor thread died; learner cannot make progress"
                         ) from actor_error[0]
-                    self.ingest()
-                    batch = self.buffer.take(
-                        current_version=self._host_version
-                    )
+                    batch = self._next_batch()
                     if batch is None:
                         time.sleep(0.002)
                         continue
                     m = self._optimize(batch)
+                    if steps_done + epochs < num_steps:   # see device loop
+                        self._prefetch_next()
                     steps_done += epochs
                     after_step(m)
                     if refresh_every and (steps_done // epochs) % refresh_every == 0:
@@ -829,17 +997,22 @@ class Learner:
                 self._refresh_league_opponent()
                 self.pool.run(actor_steps, refresh_every=0)
                 self.ingest()
-                # Learner phase: drain full batches.
-                while (
-                    batch := self.buffer.take(
-                        current_version=self._host_version
-                    )
-                ) is not None:
+                # Learner phase: drain full batches; each iteration stages
+                # the next batch behind the in-flight dispatch.
+                while (batch := self._next_batch()) is not None:
                     m = self._optimize(batch)
+                    if steps_done + epochs < num_steps:   # see device loop
+                        self._prefetch_next()
                     steps_done += epochs
                     after_step(m)
                     if steps_done >= num_steps:
                         break
+        # End-of-call prefetch flush: a batch staged behind the final
+        # dispatch was never trained on — return it to the ring so the
+        # final checkpoint (and the next train() call) see it.
+        if self.buffer is not None:
+            self._flush_prefetch()
+        self._dispatch_inflight = False
         if self.device_actor is not None:
             # End-of-call drain: the windowed stats cover this train() call
             # (the demo's block cadence) — the second best-model hook, so
@@ -863,8 +1036,8 @@ class Learner:
             **self._last_metrics,
             **{f"actor_{k}": v for k, v in actor_stats.items()},
             # Fresh end-of-run figures last so they win over logged snapshots.
-            "optimizer_steps": float(steps_done),
-            "frames_trained": float(frames_trained),
+            "optimizer_steps": float(steps_done),     # host-sync-ok: host ints
+            "frames_trained": float(frames_trained),  # host-sync-ok: host ints
             "frames_per_sec": frames_trained / max(elapsed, 1e-9),
             "elapsed_sec": elapsed,
         }

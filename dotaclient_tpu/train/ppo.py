@@ -473,6 +473,92 @@ def make_train_step(
     return step_fn
 
 
+def make_epoch_step(
+    policy: Policy,
+    config: RunConfig,
+    mesh: Mesh,
+    anchor_params: Any = None,
+):
+    """Compile the fused epoch step: ``(state, batch, perms) → (state',
+    last_metrics)`` — all ``epochs_per_batch × minibatches`` optimizer
+    updates over one consumed batch inside ONE donated XLA program.
+
+    The staged loop in ``Learner._optimize`` pays a jitted-gather dispatch
+    plus a train-step dispatch per minibatch (2·E·M host→device round trips
+    per batch); here a ``lax.scan`` walks minibatch slices of the epoch
+    permutations in-program, so one batch costs one dispatch regardless of
+    the epoch/minibatch configuration (the OPPO/Podracer observation —
+    PAPERS.md — that PPO's inner loop belongs inside the compiled program).
+
+    ``perms`` is ``[E, B] int32`` — one shuffled row order per epoch, drawn
+    host-side from the SAME seeded stream as the staged fallback. Taking
+    the permutations as an input (rather than folding a PRNG key in-graph)
+    is deliberate: on identical seeds the two paths run the same updates
+    on the same data (agreement to float-ulp XLA-fusion rounding — tested)
+    and the checkpointed ``mb_draws`` counter reconstructs the stream
+    exactly on resume, for either path. The array is E·B int32 — its
+    transfer rides the dispatch and is noise next to the batch itself.
+    With ``minibatches == 1`` the scan trains on the whole batch per epoch
+    and ``perms`` is ignored (matching the staged path, which never
+    shuffles an unsplit batch).
+
+    The train state is donated and updates in place in HBM; each minibatch
+    slice is re-constrained to the batch sharding so the update runs
+    exactly as it would on a staged minibatch. Metrics are the last
+    update's (device-resident), like the staged loop's.
+    """
+    if (config.ppo.anchor_kl_coef > 0) != (anchor_params is not None):
+        raise ValueError(
+            "anchor_params must be passed exactly when ppo.anchor_kl_coef > 0"
+        )
+    from dotaclient_tpu.parallel.mesh import data_sharding as _data_sharding
+
+    cfg = config.ppo
+    E = cfg.epochs_per_batch
+    M = max(1, cfg.minibatches)
+    B = cfg.batch_rollouts
+    if B % M:
+        raise ValueError(
+            f"batch_rollouts {B} not divisible by minibatches {M}"
+        )
+    mb = B // M
+    ds = _data_sharding(mesh, config.mesh)
+    repl = NamedSharding(mesh, P())
+    batch_shardings = jax.tree.map(
+        lambda _: ds, example_batch(config, batch=1, as_struct=True)
+    )
+    state_sharding = train_state_sharding(policy, config, mesh)
+
+    def epoch_step(state, batch, perms):
+        def body(st, idx_mb):
+            if M == 1:
+                sub = batch
+            else:
+                sub = jax.tree.map(
+                    lambda x: jax.lax.with_sharding_constraint(
+                        jnp.take(x, idx_mb, axis=0), ds
+                    ),
+                    batch,
+                )
+            return _train_step(
+                policy, cfg, st, sub, anchor_params=anchor_params
+            )
+
+        # [E, B] → [E·M, mb]: scan one optimizer step per slice; epoch e's
+        # minibatches are rows e·M..(e+1)·M of the reshape, exactly the
+        # slices the staged loop gathers.
+        idx = perms.reshape(E * M, mb)
+        state, metric_seq = jax.lax.scan(body, state, idx)
+        return state, jax.tree.map(lambda m: m[-1], metric_seq)
+
+    return jax.jit(
+        epoch_step,
+        in_shardings=(state_sharding, batch_shardings, repl),
+        out_shardings=(state_sharding, repl),
+        donate_argnums=(0,),
+    )
+
+
 def example_batch(config: RunConfig, batch: int, as_struct: bool = False) -> Batch:
     """A correctly-shaped zero batch (compile warm-up, tests, AOT)."""
     from dotaclient_tpu.models.policy import dummy_obs_batch, make_policy

@@ -34,9 +34,16 @@ Snapshot key schema (the JSONL contract; see docs/ARCHITECTURE.md
 
 Pipeline stage names wired in this repo: ``actor/step``, ``actor/infer``,
 ``actor/collect``, ``actor/drain``, ``transport/consume``,
-``transport/publish_weights``, ``buffer/insert``, ``buffer/sample``,
+``transport/publish_weights``, ``buffer/stage`` (host-row staging into the
+reused ingest lanes), ``buffer/insert``, ``buffer/sample``,
 ``learner/consume``, ``learner/assemble``, ``learner/dispatch``,
-``learner/metrics_fetch``, ``league/evaluate``.
+``learner/metrics_fetch``, ``learner/prefetch`` (batch N+1's
+drain+stage+scatter+gather, issued behind batch N's in-flight dispatch),
+``league/evaluate``. The pipelined data path also reports two gauges:
+``learner/prefetch_hit_rate`` (batches served from the prefetch lane /
+batches served) and ``learner/overlap_fraction`` (prefetch host time spent
+while a dispatch was in flight / all prefetch host time) — see
+docs/ARCHITECTURE.md "Pipelined data path".
 
 Sinks: :class:`ConsoleSink` (prints only un-slashed legacy scalar keys, so
 log lines stay readable), :class:`JsonlSink` (one JSON object per emit —

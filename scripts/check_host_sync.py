@@ -1,0 +1,163 @@
+"""CI guard: no per-step host↔device syncs sneak into the hot-path modules.
+
+The learner's throughput story rests on a discipline, not a mechanism: the
+train loop is dispatch-only, and device values are fetched exactly once per
+``log_every`` boundary (docs/ARCHITECTURE.md "Observability",
+"Pipelined data path"). That discipline regresses silently — one stray
+``float(metrics["loss"])`` in the loop turns dispatch-rate training into
+sync-rate training, and nothing crashes.
+
+This script is the static tripwire. It AST-scans the hot-path modules
+(``train/learner.py``, ``buffer/trajectory_buffer.py``) for the call
+patterns that read device values onto the host:
+
+* ``np.asarray(...)`` / ``np.array(...)``
+* ``jax.device_get(...)``
+* ``<x>.item()``
+* ``<x>.block_until_ready()`` / ``jax.block_until_ready(...)``
+* ``float(...)``
+
+and fails unless each occurrence is either
+
+* inside an ALLOWED function — construction/checkpoint/boundary code that
+  runs off the hot path by design (see ``ALLOWED_FUNCS``), or
+* explicitly annotated with a ``# host-sync-ok: <why>`` comment on the
+  same line (or the line above) — the conscious-override escape hatch.
+
+The point is friction: adding a sync to the hot path now requires either
+an annotation (visible in review) or an allowlist edit (more visible).
+Static analysis cannot prove a ``float()`` touches a device value — most
+annotated ones wrap host integers — but every NEW unannotated occurrence
+is exactly the kind of line a reviewer must look at.
+
+Exit 0 when clean; 1 with per-line diagnostics. Run by tier-1 via
+tests/test_telemetry.py.
+
+Usage:
+    python scripts/check_host_sync.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import sys
+from typing import Dict, List, Optional, Set, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Functions that legitimately sync: construction, checkpoint/restore,
+# weight publication, and log-boundary drains. Regressions INSIDE these
+# functions are boundary-cadence, not per-step — out of scope for this
+# guard (the telemetry tests count actual fetches per step).
+ALLOWED_FUNCS: Dict[str, Set[str]] = {
+    "dotaclient_tpu/train/learner.py": {
+        "__init__",
+        "_pipeline_state",
+        "_restore_pipeline",
+        "_publish_weights",
+        "_flush_league_reports",
+        "_publish_pipeline_gauges",
+        "_maybe_save_best",
+        "main",
+    },
+    "dotaclient_tpu/buffer/trajectory_buffer.py": {
+        "__init__",
+        "_matches_slot",
+        "state_dict",
+        "load_state_dict",
+        "_publish_telemetry",
+        "metrics",
+    },
+}
+
+ANNOTATION = "host-sync-ok"
+
+
+def _pattern_of(call: ast.Call) -> Optional[str]:
+    """Name of the sync pattern a Call node matches, or None."""
+    fn = call.func
+    if isinstance(fn, ast.Name) and fn.id == "float":
+        return "float()"
+    if isinstance(fn, ast.Attribute):
+        base = fn.value
+        base_name = base.id if isinstance(base, ast.Name) else None
+        if fn.attr in ("asarray", "array") and base_name == "np":
+            return f"np.{fn.attr}()"
+        if fn.attr == "device_get" and base_name == "jax":
+            return "jax.device_get()"
+        if fn.attr == "item" and not call.args:
+            return ".item()"
+        if fn.attr == "block_until_ready":
+            return ".block_until_ready()"
+    return None
+
+
+class _Scanner(ast.NodeVisitor):
+    def __init__(self) -> None:
+        self.func_stack: List[str] = []
+        self.hits: List[Tuple[int, str, Optional[str]]] = []
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.func_stack.append(node.name)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        pat = _pattern_of(node)
+        if pat is not None:
+            # innermost NAMED def wins: closures like after_step() get
+            # their own identity instead of hiding under train()
+            fn = self.func_stack[-1] if self.func_stack else None
+            self.hits.append((node.lineno, pat, fn))
+        self.generic_visit(node)
+
+
+def check_source(
+    source: str, allowed_funcs: Set[str], filename: str = "<string>"
+) -> List[str]:
+    """Return violation strings for one module's source (empty = clean)."""
+    tree = ast.parse(source, filename)
+    scanner = _Scanner()
+    scanner.visit(tree)
+    lines = source.splitlines()
+    violations = []
+    for lineno, pat, func in scanner.hits:
+        if func in allowed_funcs:
+            continue
+        here = lines[lineno - 1] if lineno - 1 < len(lines) else ""
+        above = lines[lineno - 2] if lineno >= 2 else ""
+        if ANNOTATION in here or ANNOTATION in above:
+            continue
+        where = f"in {func}()" if func else "at module level"
+        violations.append(
+            f"{filename}:{lineno}: {pat} {where} — a host↔device sync "
+            f"pattern on the hot path; move it behind a log_every boundary, "
+            f"or annotate '# {ANNOTATION}: <why>' if it only touches host "
+            f"values"
+        )
+    return violations
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.parse_args(argv)
+    all_violations: List[str] = []
+    for rel, allowed in sorted(ALLOWED_FUNCS.items()):
+        path = os.path.join(REPO_ROOT, rel)
+        with open(path) as f:
+            all_violations.extend(check_source(f.read(), allowed, rel))
+    if all_violations:
+        print("host-sync discipline check FAILED:", file=sys.stderr)
+        for v in all_violations:
+            print(f"  - {v}", file=sys.stderr)
+        return 1
+    print(f"host-sync discipline OK: {', '.join(sorted(ALLOWED_FUNCS))}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,11 +39,19 @@ REQUIRED_KEYS = (
     "span/buffer/sample/mean_s",
     "span/learner/dispatch/mean_s",
     "span/learner/metrics_fetch/mean_s",
+    # (span/learner/prefetch is NOT required: it records only productive
+    # staging — a smoke run whose ring never holds a surplus batch
+    # legitimately emits none; the gauges below always emit)
     "span/transport/publish_weights/mean_s",
     # pipeline-health gauges
     "transport/queue_depth",
     "actor/weight_staleness",
     "buffer/occupancy",
+    # pipelined-data-path gauges (ISSUE 2): batches served from the
+    # prefetch lane, and the fraction of staging work overlapped with an
+    # in-flight dispatch
+    "learner/prefetch_hit_rate",
+    "learner/overlap_fraction",
     # throughput counters
     "actor/frames_shipped",
     "actor/rollouts_shipped",

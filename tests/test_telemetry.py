@@ -274,6 +274,65 @@ class TestLearnerTelemetry:
         )
 
 
+class TestHostSyncGuard:
+    @pytest.fixture()
+    def guard(self):
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "check_host_sync",
+            os.path.join(root, "scripts", "check_host_sync.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_hot_path_modules_are_clean(self, guard, capsys):
+        """The CI tripwire end-to-end: the learner and buffer hot paths
+        carry no unannotated host↔device sync patterns (ISSUE 2 satellite:
+        the dispatch-only discipline cannot silently regress)."""
+        assert guard.main([]) == 0
+        assert "host-sync discipline OK" in capsys.readouterr().out
+
+    def test_flags_unannotated_sync_patterns(self, guard):
+        src = (
+            "def hot(m):\n"
+            "    a = float(m['loss'])\n"
+            "    b = np.asarray(m['x'])\n"
+            "    c = jax.device_get(m)\n"
+            "    d = m['y'].item()\n"
+            "    m['z'].block_until_ready()\n"
+            "    return a, b, c, d\n"
+        )
+        violations = guard.check_source(src, set(), "x.py")
+        assert len(violations) == 5
+        assert any("float()" in v for v in violations)
+        assert any(".item()" in v for v in violations)
+
+    def test_annotation_and_allowlist_suppress(self, guard):
+        src = (
+            "def boundary(m):\n"
+            "    return float(m)\n"
+            "def hot(m):\n"
+            "    # host-sync-ok: host integer\n"
+            "    return float(m)\n"
+        )
+        assert guard.check_source(src, {"boundary"}, "x.py") == []
+        # ... but only for the named function / annotated line
+        assert len(guard.check_source(src, set(), "x.py")) == 1
+
+    def test_closures_get_own_identity(self, guard):
+        """A sync inside a closure of an allowed function is still flagged:
+        the innermost named def is the unit of allowance."""
+        src = (
+            "def train():\n"
+            "    def after_step(m):\n"
+            "        return float(m)\n"
+            "    return after_step\n"
+        )
+        violations = guard.check_source(src, {"train"}, "x.py")
+        assert len(violations) == 1 and "after_step" in violations[0]
+
+
 class TestSchemaChecker:
     @pytest.fixture()
     def checker(self):

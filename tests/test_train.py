@@ -16,6 +16,7 @@ from dotaclient_tpu.train import (
     gae,
     gae_reference,
     init_train_state,
+    make_epoch_step,
     make_train_step,
     ppo_loss,
 )
@@ -482,6 +483,104 @@ class TestTrainStep:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
             )
+
+    def test_epoch_step_matches_staged_minibatch_path(self, setup):
+        """The fused epoch step (ONE donated dispatch for all E×M updates)
+        must reproduce the staged loop's result when fed the same
+        permutations — an execution-plan change, not a training change
+        (ISSUE 2 acceptance). Exactness bound: the scanned program fuses
+        differently from separate dispatches, so agreement is to float-ulp
+        rounding (measured ~1e-10 absolute after 4 updates on CPU), not
+        bitwise."""
+        policy, params = setup
+        # minibatch size (B/M) must stay divisible by the 8 forced host
+        # devices — the same constraint the Learner validates at init
+        E, M = 2, 2
+        cfg = dataclasses.replace(
+            CFG,
+            ppo=dataclasses.replace(
+                CFG.ppo, epochs_per_batch=E, minibatches=M, batch_rollouts=16
+            ),
+        )
+        mesh = make_mesh(cfg.mesh)
+        batch = random_batch(policy, params, batch=16, seed=7)
+        B, mb = 16, 16 // M
+        rng = np.random.default_rng(41)
+        perms = np.stack([rng.permutation(B) for _ in range(E)])
+
+        # staged path: a jitted gather + a train-step dispatch per minibatch
+        from dotaclient_tpu.parallel import data_sharding
+
+        gather = jax.jit(
+            lambda b, idx: jax.tree.map(lambda x: x[idx], b),
+            out_shardings=data_sharding(mesh, cfg.mesh),
+        )
+        staged = init_train_state(params, cfg.ppo)
+        step = make_train_step(policy, cfg, mesh)
+        staged_metrics = None
+        for e in range(E):
+            for i in range(M):
+                idx = jnp.asarray(perms[e, i * mb:(i + 1) * mb], jnp.int32)
+                staged, staged_metrics = step(staged, gather(batch, idx))
+
+        # fused path: everything in one program
+        fused = init_train_state(params, cfg.ppo)
+        epoch_step = make_epoch_step(policy, cfg, mesh)
+        fused, fused_metrics = epoch_step(
+            fused, batch, jnp.asarray(perms, jnp.int32)
+        )
+
+        assert int(fused.step) == int(staged.step) == E * M
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-7
+            ),
+            fused.params,
+            staged.params,
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                rtol=1e-4, atol=1e-7,
+            ),
+            fused.opt_state,
+            staged.opt_state,
+        )
+        for k in ("loss", "policy_loss", "value_loss", "entropy"):
+            np.testing.assert_allclose(
+                np.asarray(fused_metrics[k]), np.asarray(staged_metrics[k]),
+                rtol=1e-4, atol=1e-7,
+            )
+
+    def test_epoch_step_single_minibatch_matches_plain_steps(self, setup):
+        """M == 1: the epoch step scans E whole-batch updates and ignores
+        the permutation placeholder — matching E plain train steps (same
+        float-ulp fusion bound as the minibatched parity test)."""
+        policy, params = setup
+        E = 3
+        cfg = dataclasses.replace(
+            CFG,
+            ppo=dataclasses.replace(
+                CFG.ppo, epochs_per_batch=E, minibatches=1, batch_rollouts=8
+            ),
+        )
+        mesh = make_mesh(cfg.mesh)
+        batch = random_batch(policy, params, batch=8, seed=11)
+        plain = init_train_state(params, cfg.ppo)
+        step = make_train_step(policy, cfg, mesh)
+        for _ in range(E):
+            plain, _ = step(plain, batch)
+        fused = init_train_state(params, cfg.ppo)
+        epoch_step = make_epoch_step(policy, cfg, mesh)
+        perms = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (E, 8))
+        fused, _ = epoch_step(fused, batch, perms)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-7
+            ),
+            fused.params,
+            plain.params,
+        )
 
     def test_learning_reduces_loss_on_fixed_batch(self, setup):
         """A few steps on one batch must reduce the PPO objective (sanity

@@ -212,6 +212,117 @@ class TestTrajectoryBuffer:
         expect = np.stack([np.asarray(one_rollout(i)["rewards"]) for i in range(2, 10)])
         np.testing.assert_array_equal(np.asarray(batch["rewards"]), expect)
 
+    def test_staging_lane_reuse_is_bitexact(self):
+        """Back-to-back ingests rotate through the REUSED staging lanes
+        (BufferConfig.staging_slots): later assemblies must never corrupt
+        rows an earlier scatter staged from the same memory."""
+        buf, cfg = self.make(capacity=16, batch_rollouts=8, min_fill=8)
+        first = [self.decoded(i) for i in range(8)]
+        buf.add(first, 0)
+        # cycles through every lane at least twice at staging_slots=2
+        for wave in range(1, 4):
+            buf.add([self.decoded(100 * wave + i) for i in range(2)], 0)
+        batch = buf.take(8)
+        expect = np.stack([np.asarray(r[1]["rewards"]) for r in first])
+        np.testing.assert_array_equal(np.asarray(batch["rewards"]), expect)
+
+    def test_hold_release_and_requeue(self):
+        """The prefetch lane's contract: held slots are out of circulation
+        until released; a requeued batch returns to the FRONT of the order
+        and re-gathers the same rows."""
+        buf, cfg = self.make(capacity=16, batch_rollouts=8, min_fill=8)
+        rolls = [self.decoded(i) for i in range(12)]
+        buf.add(rolls, 0)
+        held = buf.take(8, hold=True)
+        assert held is not None
+        batch, ticket = held
+        assert buf.size == 4                     # held slots left the order
+        buf.requeue(ticket)
+        assert buf.size == 12                    # ... and came back in front
+        batch2 = buf.take(8)
+        np.testing.assert_array_equal(
+            np.asarray(batch["rewards"]), np.asarray(batch2["rewards"])
+        )
+        # a released batch's slots become reusable: ring refills to capacity
+        buf.add([self.decoded(50 + i) for i in range(12)], 0)
+        assert buf.size == 16
+
+    def test_eviction_during_inflight_hold_spares_held_slots(self):
+        """An ingest racing an in-flight (held) batch may evict unconsumed
+        slots but must never overwrite the held ones — re-gathering after a
+        requeue returns bit-identical rows."""
+        buf, cfg = self.make(capacity=16, batch_rollouts=8, min_fill=8)
+        buf.add([self.decoded(i) for i in range(16)], 0)          # full ring
+        batch, ticket = buf.take(8, hold=True)
+        # 10 new rollouts: 8 unconsumed slots evicted... but only 8 exist —
+        # the surplus 2 must be dropped, not scribbled over held slots
+        kept = buf.add([self.decoded(100 + i) for i in range(10)], 0)
+        assert kept == 8
+        assert buf.dropped_overflow >= 2
+        buf.requeue(ticket)
+        again = buf.take(8)
+        np.testing.assert_array_equal(
+            np.asarray(batch["rewards"]), np.asarray(again["rewards"])
+        )
+
+    def test_add_device_drops_when_all_slots_held(self):
+        """Degenerate capacity == batch with a batch in flight: the device
+        ingest drops (counted) instead of corrupting or crashing."""
+        buf, cfg = self.make(capacity=8, batch_rollouts=8, min_fill=8)
+        buf.add([self.decoded(i) for i in range(8)], 0)
+        _, ticket = buf.take(8, hold=True)
+        chunk = jax.tree.map(
+            lambda *xs: np.stack(xs), *[self.decoded(50 + i)[1] for i in range(4)]
+        )
+        assert buf.add_device(chunk, 0) == 0
+        assert buf.dropped_overflow >= 4
+        buf.release(ticket)
+        assert buf.add_device(chunk, 0) == 4     # slots reusable again
+
+    def test_take_staleness_reenforced_interleaved_with_add(self):
+        """Pipelined ingest interleaves add and take: rollouts fresh at the
+        ingest door must STILL be dropped at consume time once the version
+        has moved past the staleness window while they sat in the ring."""
+        buf, cfg = self.make(capacity=32, batch_rollouts=8, min_fill=8)
+        limit = cfg.ppo.max_staleness * cfg.ppo.steps_per_batch
+        buf.add([self.decoded(i, version=0) for i in range(8)], 0)
+        # interleaved newer ingest, then the version advances past the
+        # window for the first wave only
+        buf.add([self.decoded(10 + i, version=limit + 1) for i in range(8)],
+                limit + 1)
+        batch = buf.take(8, current_version=limit + 1)
+        assert buf.dropped_stale == 8
+        expect = np.stack(
+            [np.asarray(one_rollout(10 + i)["rewards"]) for i in range(8)]
+        )
+        np.testing.assert_array_equal(np.asarray(batch["rewards"]), expect)
+
+    def test_skew_drop_routes_through_logging_and_counter(self, caplog):
+        """The shape-skew warning goes through logging + a telemetry
+        counter — never a bare print (satellite)."""
+        import logging
+
+        from dotaclient_tpu.utils import telemetry as tel
+
+        reg = tel.Registry()
+        cfg = dataclasses.replace(
+            CFG,
+            buffer=dataclasses.replace(
+                CFG.buffer, capacity_rollouts=16, min_fill=8
+            ),
+            ppo=dataclasses.replace(CFG.ppo, batch_rollouts=8),
+        )
+        buf = TrajectoryBuffer(cfg, make_mesh(cfg.mesh), registry=reg)
+        bad = ({"model_version": 0, "env_id": 0, "rollout_id": 1,
+                "length": 4, "total_reward": 0.0},
+               {"not_a_batch": np.zeros((3,), np.float32)})
+        with caplog.at_level(
+            logging.WARNING, logger="dotaclient_tpu.buffer.trajectory_buffer"
+        ):
+            buf.add([bad], current_version=0)
+        assert any("shapes" in r.getMessage() for r in caplog.records)
+        assert reg.snapshot()["buffer/skew_drops_total"] == 1.0
+
     def test_feeds_train_step(self):
         """Buffer output is a valid train batch end-to-end."""
         from dotaclient_tpu.models import init_params, make_policy
