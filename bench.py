@@ -32,6 +32,174 @@ import jax
 import numpy as np
 
 ANCHOR_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_anchor.json")
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def bench_transport(config) -> dict:
+    """Transport stage (ISSUE 3): measured on CPU only, no accelerator.
+
+    * rollout lanes — a child OS process (the real topology: a separate
+      actor process) ships rollout frames through loopback TCP and through
+      the shared-memory ring; both are drained with the raw server-side
+      drain (decode cost is identical on both lanes and would only dilute
+      the transport difference). Two frame sizes are measured: the
+      benchmark config's full encoded chunk (the bandwidth-bound point)
+      and a 16 KiB frame (the per-frame-overhead point — smaller
+      obs/rollout configs land here). The headline ``shm_vs_socket`` is
+      the geometric mean of the per-size ratios (best of 3 interleaved
+      trials each — this host's memory bandwidth swings >10x on a seconds
+      scale, so best-of-N is the capability measurement, the same rule the
+      optimizer stage applies); the shm lane must win by ≥3×.
+    * weights fanout — N in-process actors on one ``TransportServer``;
+      ``publish_weights`` must be an O(1)-per-connection enqueue (its wall
+      time is the serialize cost, never a send), and delivery lag is the
+      time until every actor observes the final version.
+    """
+    import subprocess
+    import sys
+
+    import jax as _jax  # local alias: this stage never touches devices
+
+    from dotaclient_tpu.models import init_params, make_policy
+    from dotaclient_tpu.transport import (
+        ShmTransportServer,
+        SocketTransport,
+        TransportServer,
+        encode_rollout_bytes,
+        encode_weights,
+    )
+    from dotaclient_tpu.train import example_batch
+
+    # one real rollout frame for the benchmark config's shapes
+    row = _jax.tree.map(
+        lambda x: np.asarray(x[0]), example_batch(config, batch=1)
+    )
+    full_frame = bytes(
+        encode_rollout_bytes(row, 0, 0, 0, config.ppo.rollout_len, 0.0)
+    )
+
+    def run_lane(lane: str, tag: str, n_frames: int, frame_bytes: int) -> float:
+        if lane == "socket":
+            server = TransportServer(port=0, max_rollouts=4 * n_frames)
+            addr = f"{server.address[0]}:{server.address[1]}"
+        else:
+            server = ShmTransportServer(
+                name=f"bench-{os.getpid()}-{tag}", slots=2,
+                ring_bytes=config.transport.shm_ring_bytes,
+                weights_bytes=1 << 20,
+            )
+            addr = server.address
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                os.path.join(REPO, "scripts", "bench_transport_producer.py"),
+                "--lane", lane, "--addr", addr,
+                "--frames", str(n_frames), "--bytes", str(frame_bytes),
+            ],
+            cwd=REPO,
+        )
+        got, base, t0 = 0, 0, None
+        t_spawn = time.perf_counter()
+        deadline = time.time() + 120
+        batch = None
+        while got < n_frames and time.time() < deadline:
+            batch = server._drain(4 * n_frames, timeout=1.0)
+            if batch:
+                if t0 is None:  # clock starts at first arrival, not spawn
+                    t0 = time.perf_counter()
+                    base = len(batch)
+                got += len(batch)
+        fps = 0.0
+        if t0 is not None and got > base:
+            fps = (got - base) / (time.perf_counter() - t0)
+        elif got:  # degenerate single-batch drain: include spawn latency
+            fps = got / (time.perf_counter() - t_spawn)
+        batch = None   # release zero-copy views before the server goes
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()   # never leave a spinning producer behind
+            proc.wait(timeout=10)
+        server.close()
+        return fps
+
+    sizes = {"16k": (16384, 4000), "full": (len(full_frame), 1500)}
+    lanes: dict = {}
+    for label, (nbytes, n_frames) in sizes.items():
+        socket_fps, shm_fps = 0.0, 0.0
+        for trial in range(3):   # interleaved: noise hits both lanes
+            socket_fps = max(
+                socket_fps,
+                run_lane("socket", f"s{label}{trial}", n_frames, nbytes),
+            )
+            shm_fps = max(
+                shm_fps, run_lane("shm", f"m{label}{trial}", n_frames, nbytes)
+            )
+        lanes[label] = {
+            "frame_bytes": nbytes,
+            "socket_fps": round(socket_fps, 1),
+            "shm_fps": round(shm_fps, 1),
+            "ratio": round(shm_fps / socket_fps, 2) if socket_fps else 0.0,
+        }
+    ratios = [v["ratio"] for v in lanes.values()]
+    # a size that failed to measure (ratio 0) must fail the headline, not
+    # silently shrink its coverage to the surviving sizes
+    headline = (
+        round(float(np.exp(np.mean(np.log(ratios)))), 2)
+        if ratios and all(r > 0 for r in ratios)
+        else 0.0
+    )
+
+    # -- weights fanout at N simulated actors --------------------------------
+    policy = make_policy(config.model, config.obs, config.actions)
+    params = _jax.tree.map(
+        np.asarray, init_params(policy, _jax.random.PRNGKey(0))
+    )
+    n_actors = 8
+    server = TransportServer(port=0)
+    host, port = server.address
+    actors = [SocketTransport(host, port) for _ in range(n_actors)]
+    deadline = time.time() + 10
+    while server.n_connected < n_actors and time.time() < deadline:
+        time.sleep(0.01)
+    publish_s = []
+    n_publishes = 12
+    for v in range(1, n_publishes + 1):
+        msg = encode_weights(params, v, wire_dtype=config.transport.wire_dtype)
+        t0 = time.perf_counter()
+        server.publish_weights(msg)
+        publish_s.append(time.perf_counter() - t0)
+        time.sleep(0.03)
+    t0 = time.perf_counter()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        versions = [
+            (a.latest_weights().version if a.latest_weights() else 0)
+            for a in actors
+        ]
+        if all(v == n_publishes for v in versions):
+            break
+        time.sleep(0.01)
+    delivery_s = time.perf_counter() - t0
+    f32_bytes = len(encode_weights(params, 1).SerializeToString())
+    bf16_bytes = len(
+        encode_weights(params, 1, wire_dtype="bfloat16").SerializeToString()
+    )
+    for a in actors:
+        a.close()
+    server.close()
+
+    return {
+        "socket_rollout_fps": lanes["full"]["socket_fps"],
+        "shm_rollout_fps": lanes["full"]["shm_fps"],
+        "shm_vs_socket": headline,
+        "rollout_lanes": lanes,
+        "fanout_actors": n_actors,
+        "fanout_publish_p50_s": round(sorted(publish_s)[len(publish_s) // 2], 6),
+        "fanout_delivery_lag_s": round(delivery_s, 4),
+        "fanout_wire_bytes_f32": f32_bytes,
+        "fanout_wire_bytes_bf16": bf16_bytes,
+    }
 
 
 def main() -> None:
@@ -190,6 +358,13 @@ def main() -> None:
     except (OSError, ValueError, KeyError, IndexError):
         stages = {}
 
+    # -- transport stage: socket vs shm lanes, fanout latency (CPU-only) -----
+    try:
+        transport = bench_transport(config)
+    except Exception as e:  # a broken /dev/shm or spawn failure must not
+        # destroy the already-measured headline numbers
+        transport = {"error": f"{type(e).__name__}: {e}"}
+
     anchor = None
     if os.path.exists(ANCHOR_PATH):
         try:
@@ -221,6 +396,7 @@ def main() -> None:
                 "fused_k8_frames_per_sec": round(k8_fps, 1),
                 "actor_frames_per_sec": round(actor_fps, 1),
                 "stages": stages,
+                "transport": transport,
                 "telemetry_jsonl": telemetry_path,
             }
         )

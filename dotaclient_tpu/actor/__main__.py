@@ -21,14 +21,71 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import random
 import sys
 import time
+
+
+def connect_with_backoff(
+    factory,
+    max_attempts: int = 6,
+    base_delay: float = 0.5,
+    max_delay: float = 10.0,
+    sleep=time.sleep,
+    rng: "random.Random | None" = None,
+):
+    """Call ``factory()`` until it returns a transport, with exponential
+    backoff + full jitter between attempts (bounded — a learner that is
+    really gone must still fail fast enough for the supervisor to act).
+
+    Every retry (attempt beyond the first) bumps the
+    ``transport/reconnects_total`` counter; the final failure re-raises the
+    last connection error.
+    """
+    from dotaclient_tpu.utils import telemetry
+
+    rng = rng or random.Random()
+    tel = telemetry.get_registry()
+    last: "BaseException | None" = None
+    for attempt in range(max_attempts):
+        if attempt:
+            tel.counter("transport/reconnects_total").inc()
+            # full jitter: uniform in (0, base·2^(k-1)], capped — a restarted
+            # learner must not be met by a synchronized thundering herd
+            delay = min(max_delay, base_delay * (2 ** (attempt - 1)))
+            sleep(rng.uniform(0.0, delay))
+        try:
+            return factory()
+        except (ConnectionError, OSError) as e:
+            last = e
+    raise ConnectionError(
+        f"transport unreachable after {max_attempts} attempts"
+    ) from last
+
+
+def _transport_factory(args):
+    """Build the (re)connect callable for the configured transport."""
+    if args.connect and args.connect.startswith("shm://"):
+        from dotaclient_tpu.transport.shm_transport import ShmTransport
+
+        name = args.connect[len("shm://"):]
+        return lambda: ShmTransport(name)
+    if args.connect:
+        from dotaclient_tpu.transport.socket_transport import SocketTransport
+
+        host, port = args.connect.rsplit(":", 1)
+        return lambda: SocketTransport(host, int(port))
+    from dotaclient_tpu.transport.queues import AmqpTransport
+
+    host, _, port = args.amqp.partition(":")
+    return lambda: AmqpTransport(host, int(port or 5672))
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--connect", type=str, default=None,
-                   help="learner TransportServer address host:port")
+                   help="learner TransportServer address host:port, or "
+                        "shm://NAME for the same-host shared-memory lane")
     p.add_argument("--amqp", type=str, default=None,
                    help="RabbitMQ broker address host[:port]")
     p.add_argument("--n-envs", type=int, default=64)
@@ -48,6 +105,9 @@ def main(argv=None) -> int:
     p.add_argument("--platform", type=str, default="cpu",
                    choices=("cpu", "tpu"),
                    help="JAX platform; cpu by default (the learner owns the TPU)")
+    p.add_argument("--max-reconnects", type=int, default=6,
+                   help="bounded connect attempts (exponential backoff + "
+                        "jitter) before exiting non-zero for the supervisor")
     args = p.parse_args(argv)
     if bool(args.connect) == bool(args.amqp):
         p.error("exactly one of --connect or --amqp is required")
@@ -71,16 +131,16 @@ def main(argv=None) -> int:
     from dotaclient_tpu.models import init_params, make_policy
     from dotaclient_tpu.transport import decode_weights
 
-    if args.connect:
-        from dotaclient_tpu.transport.socket_transport import SocketTransport
-
-        host, port = args.connect.rsplit(":", 1)
-        transport = SocketTransport(host, int(port))
-    else:
-        from dotaclient_tpu.transport.queues import AmqpTransport
-
-        host, _, port = args.amqp.partition(":")
-        transport = AmqpTransport(host, int(port or 5672))
+    factory = _transport_factory(args)
+    try:
+        transport = connect_with_backoff(
+            factory, max_attempts=args.max_reconnects,
+            rng=random.Random(args.seed),
+        )
+    except (ConnectionError, OSError) as e:
+        print(f"actor: cannot reach learner ({e}); exiting for restart",
+              file=sys.stderr, flush=True)
+        return 1
 
     config = default_config()
     config = dataclasses.replace(
@@ -105,7 +165,12 @@ def main(argv=None) -> int:
     deadline = time.time() + 60.0
     params = None
     while time.time() < deadline:
-        msg = transport.latest_weights()
+        try:
+            msg = transport.latest_weights()
+        except ConnectionError as e:
+            print(f"actor: learner lost while waiting for weights ({e}); "
+                  f"exiting for restart", file=sys.stderr, flush=True)
+            return 1
         if msg is not None:
             version, tree = decode_weights(msg)
             params = jax.tree.map(jax.numpy.asarray, tree)
@@ -147,24 +212,42 @@ def main(argv=None) -> int:
         seed=args.seed, version=version,
     )
     t0 = time.time()
-    try:
-        steps = 0
-        while not args.steps or steps < args.steps:
+    steps = 0
+    while not args.steps or steps < args.steps:
+        try:
             pool.run(args.refresh_every, refresh_every=args.refresh_every)
-            steps += args.refresh_every
-            if steps % 256 == 0:
-                s = pool.stats()
-                print(
-                    f"[actor {args.seed}] {s['env_steps']:.0f} env steps, "
-                    f"{s['rollouts_shipped']:.0f} rollouts, "
-                    f"{s['env_steps'] / max(time.time() - t0, 1e-9):.0f} steps/s, "
-                    f"version {pool.version}",
-                    flush=True,
+        except ConnectionError as e:
+            # transient hiccup (learner restart, broker blip): bounded
+            # backoff+jitter reconnect before giving up to the supervisor
+            print(f"actor: transport lost ({e}); reconnecting",
+                  file=sys.stderr, flush=True)
+            try:
+                transport.close()
+            except OSError:
+                pass
+            try:
+                transport = connect_with_backoff(
+                    factory, max_attempts=args.max_reconnects,
+                    rng=random.Random(args.seed ^ steps),
                 )
-    except ConnectionError as e:
-        print(f"actor: transport lost ({e}); exiting for restart",
-              file=sys.stderr, flush=True)
-        return 1
+            except (ConnectionError, OSError) as e2:
+                print(
+                    f"actor: reconnect failed ({e2}); exiting for restart",
+                    file=sys.stderr, flush=True,
+                )
+                return 1
+            pool.transport = transport   # pool re-resolves per publish/refresh
+            continue
+        steps += args.refresh_every
+        if steps % 256 == 0:
+            s = pool.stats()
+            print(
+                f"[actor {args.seed}] {s['env_steps']:.0f} env steps, "
+                f"{s['rollouts_shipped']:.0f} rollouts, "
+                f"{s['env_steps'] / max(time.time() - t0, 1e-9):.0f} steps/s, "
+                f"version {pool.version}",
+                flush=True,
+            )
     return 0
 
 

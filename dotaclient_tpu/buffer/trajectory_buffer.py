@@ -33,6 +33,11 @@ from dotaclient_tpu.utils import telemetry
 logger = logging.getLogger(__name__)
 
 
+def _pow2ceil(n: int) -> int:
+    """Smallest power of two >= n (n >= 1)."""
+    return 1 << (max(1, n) - 1).bit_length()
+
+
 class TrajectoryBuffer:
     """FIFO ring of rollout chunks in device memory.
 
@@ -128,11 +133,22 @@ class TrajectoryBuffer:
         self._staging_lanes = max(1, config.buffer.staging_slots)
         self._staging: Optional[List[Any]] = None
         self._staging_idx = 0
+        # Host ingest pads to power-of-two row counts (see add()), so the
+        # lanes must hold the padded form of a full-capacity ingest.
+        self._staging_rows = _pow2ceil(cap)
+
+        # Retrace accounting (ADVICE round 1): every distinct rows leading
+        # dim compiles one XLA program. Host ingest pads to powers of two
+        # and the device path scatters pow2 chunks, so the program set is
+        # bounded at log2(capacity)+1 — `scatter_traces` proves it.
+        self.scatter_traces = 0
+
+        def _scatter_impl(store, rows, idx):
+            self.scatter_traces += 1   # runs at trace time only
+            return jax.tree.map(lambda s, r: s.at[idx].set(r), store, rows)
 
         self._scatter = jax.jit(
-            lambda store, rows, idx: jax.tree.map(
-                lambda s, r: s.at[idx].set(r), store, rows
-            ),
+            _scatter_impl,
             donate_argnums=(0,),
             out_shardings=jax.tree.map(lambda _: self._sharding, template),
         )
@@ -197,34 +213,35 @@ class TrajectoryBuffer:
             return 0
 
         with self._tel.span("buffer/insert"):
-            rows = self._stage_rows([arrays for _, arrays in fresh])
             slots = self._alloc_slots(len(fresh))
             if len(slots) < len(fresh):
                 fresh = fresh[: len(slots)]
-                rows = jax.tree.map(lambda r: r[: len(slots)], rows)
                 if not fresh:
                     self._publish_telemetry()
                     return 0
-            idx = np.asarray(slots, dtype=np.int32)   # host-sync-ok: host ints
-            # Scatter in power-of-two chunks (binary decomposition of the
-            # ingest count): a varying leading dim would compile one XLA
-            # program per distinct count — up to `capacity` of them (ADVICE
-            # round 1). This bounds it at log2(capacity) programs. numpy rows
-            # transfer on the dispatch path (no separate synchronizing
-            # device_put).
-            pos = 0
-            remaining = len(fresh)
-            while remaining:
-                chunk = 1 << (remaining.bit_length() - 1)
-                rows_chunk = jax.tree.map(lambda r: r[pos:pos + chunk], rows)
-                self._store = self._scatter(
-                    self._store, rows_chunk, idx[pos:pos + chunk]
-                )
-                pos += chunk
-                remaining -= chunk
-            self._slot_version[idx] = [m["model_version"] for m, _ in fresh]
+            n = len(fresh)
+            # Pad the ingest group to a power-of-two bucket and scatter ONCE
+            # (ADVICE round 1): a varying leading dim would compile one XLA
+            # program per distinct count — up to `capacity` of them. Pad
+            # rows are copies of the LAST REAL ROW and their indices
+            # duplicate its slot, so the duplicate writes are identical
+            # (order-independent) and the pad never enters the slot
+            # bookkeeping below. Bounds the program set at log2(capacity)+1
+            # (asserted via `scatter_traces` in tests). numpy rows transfer
+            # on the dispatch path (no separate synchronizing device_put).
+            n_pad = _pow2ceil(n)
+            rows = self._stage_rows(
+                [arrays for _, arrays in fresh], pad_to=n_pad
+            )
+            idx = np.empty((n_pad,), np.int32)
+            idx[:n] = slots   # host-sync-ok: host ints
+            idx[n:] = slots[-1]
+            self._store = self._scatter(self._store, rows, idx)
+            self._slot_version[idx[:n]] = [
+                m["model_version"] for m, _ in fresh
+            ]
             self._order.extend(slots)
-            self.ingested += len(fresh)
+            self.ingested += n
         self._publish_telemetry()
         return len(fresh)
 
@@ -262,21 +279,24 @@ class TrajectoryBuffer:
                 break
         return slots
 
-    def _stage_rows(self, arrays_list: List[Any]) -> Any:
+    def _stage_rows(self, arrays_list: List[Any], pad_to: int = 0) -> Any:
         """Copy decoded rollout rows into the next staging lane and return
-        per-leaf views of the first ``len(arrays_list)`` rows.
+        per-leaf views of the first ``max(len(arrays_list), pad_to)`` rows,
+        with rows beyond ``len(arrays_list)`` filled with copies of the
+        last real row (the pow2 scatter pad — see :meth:`add`).
 
-        The lanes are preallocated at ring capacity (the most one ``add``
-        can ingest) and REUSED round-robin: no per-ingest allocation, and
-        the ``staging_slots``-deep rotation guarantees the rows a possibly
-        still-in-flight previous scatter reads are never overwritten by the
-        current assembly — the double-buffering that lets the learner issue
-        batch N+1's ingest while batch N's epoch step runs.
+        The lanes are preallocated at (pow2-padded) ring capacity (the most
+        one ``add`` can ingest) and REUSED round-robin: no per-ingest
+        allocation, and the ``staging_slots``-deep rotation guarantees the
+        rows a possibly still-in-flight previous scatter reads are never
+        overwritten by the current assembly — the double-buffering that
+        lets the learner issue batch N+1's ingest while batch N's epoch
+        step runs.
         """
         if self._staging is None:
             leaves_per_lane = [
                 [
-                    np.empty((self.capacity,) + shape, dtype)
+                    np.empty((self._staging_rows,) + shape, dtype)
                     for shape, dtype in self._tmpl_leaves
                 ]
                 for _ in range(self._staging_lanes)
@@ -288,6 +308,7 @@ class TrajectoryBuffer:
         lane = self._staging[self._staging_idx]
         self._staging_idx = (self._staging_idx + 1) % self._staging_lanes
         n = len(arrays_list)
+        n_out = max(n, pad_to)
         with self._tel.span("buffer/stage"):
             dst_leaves = jax.tree.leaves(lane)
             for i, arrays in enumerate(arrays_list):
@@ -295,7 +316,11 @@ class TrajectoryBuffer:
                 # verified the pytree structure at the ingest door
                 for dst, src in zip(dst_leaves, jax.tree.leaves(arrays)):
                     dst[i] = src
-        return jax.tree.map(lambda dst: dst[:n], lane)
+            for dst in dst_leaves:
+                # pad rows mirror the last real row — their scatter indices
+                # duplicate its slot, so the writes must be bit-identical
+                dst[n:n_out] = dst[n - 1]
+        return jax.tree.map(lambda dst: dst[:n_out], lane)
 
     def add_device(self, chunk: Dict[str, Any], version: int) -> int:
         """Ingest a device-resident chunk batch (arrays ``[L, T, ...]``, the

@@ -233,6 +233,35 @@ class BufferConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class TransportConfig:
+    """Cross-process transport tuning (socket/shm lanes; ISSUE 3).
+
+    The weights fanout is non-blocking: ``publish_weights`` is an O(1)
+    enqueue per connection and per-connection writer threads do the actual
+    sends, coalescing to the latest version (stale intermediate weights are
+    worthless — IMPACT licenses bounded staleness, PAPERS.md)."""
+
+    # Wire dtype for the weights fanout: "float32" (bit-exact) or
+    # "bfloat16" — float32 params are cast at encode, halving the fanout
+    # bytes per publish; the actor upcasts on apply (lossless: every bf16
+    # value is exactly representable in f32). Rollout payloads are
+    # untouched (actors already choose their own compute dtype).
+    wire_dtype: str = "float32"
+    # A connection whose writer thread is still stuck sending when this
+    # many NEWER publishes have been enqueued is declared over-budget and
+    # dropped (counted in transport/fanout_conns_dropped) — a stalled actor
+    # must never delay the learner or its peers.
+    fanout_max_lag: int = 8
+    # Shared-memory same-host lane (--transport shm): per-actor SPSC
+    # rollout ring size and the seqlock'd weights slab size. The slab must
+    # hold one encoded ModelWeights payload; rings drop-newest (counted)
+    # when the learner falls behind.
+    shm_slots: int = 16
+    shm_ring_bytes: int = 8 * 1024 * 1024
+    shm_weights_bytes: int = 32 * 1024 * 1024
+
+
+@dataclasses.dataclass(frozen=True)
 class LeagueConfig:
     enabled: bool = False
     pool_size: int = 8
@@ -278,6 +307,7 @@ class RunConfig:
     reward: RewardConfig = RewardConfig()
     mesh: MeshConfig = MeshConfig()
     buffer: BufferConfig = BufferConfig()
+    transport: TransportConfig = TransportConfig()
     league: LeagueConfig = LeagueConfig()
     checkpoint_dir: str = "checkpoints"
     checkpoint_every: int = 100
@@ -319,6 +349,8 @@ class RunConfig:
             reward=RewardConfig(**raw.get("reward", {})),
             mesh=MeshConfig(**raw["mesh"]),
             buffer=BufferConfig(**raw["buffer"]),
+            # .get: absent in checkpoints written before TransportConfig
+            transport=TransportConfig(**raw.get("transport", {})),
             league=LeagueConfig(**raw["league"]),
             # .get: absent in checkpoints written before the field existed
             checkpoint_best_min_episodes=raw.get(

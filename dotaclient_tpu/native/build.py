@@ -94,7 +94,9 @@ def load_library(auto_build: bool = True) -> Optional[ctypes.CDLL]:
                     pass
         lib.dota_decode_rollout.restype = ctypes.c_int32
         lib.dota_decode_rollout.argtypes = [
-            ctypes.c_char_p, ctypes.c_uint64,
+            # void* (not char*): callers pass bytes directly OR a raw
+            # pointer into a memoryview (the shm lane's zero-copy frames)
+            ctypes.c_void_p, ctypes.c_uint64,
             ctypes.POINTER(RolloutHeader),
             ctypes.POINTER(TensorEntry), ctypes.c_int32,
         ]

@@ -664,12 +664,16 @@ class Learner:
 
     def _publish_weights(self) -> None:
         """Serialize current params to the transport's weights fanout (one
-        full param fetch — call at refresh cadence, not per step)."""
+        full param fetch — call at refresh cadence, not per step).
+        ``transport.wire_dtype="bfloat16"`` halves the fanout bytes (actors
+        upcast on apply); the fanout itself is non-blocking — a stalled
+        actor can never stall this call (socket_transport.py)."""
         with self.telemetry.span("transport/publish_weights"):
             self.transport.publish_weights(
                 encode_weights(
                     jax.tree.map(np.asarray, self.state.params),
                     self._host_version,
+                    wire_dtype=self.config.transport.wire_dtype,
                 )
             )
 
@@ -1118,13 +1122,25 @@ def main(argv=None) -> Dict[str, float]:
     )
     p.add_argument(
         "--transport", type=str, default="inproc",
-        choices=("inproc", "socket", "amqp"),
+        choices=("inproc", "socket", "shm", "amqp"),
         help="experience/weights transport; socket listens for actor "
-        "processes, amqp targets a RabbitMQ broker",
+        "processes, shm serves same-host actors over shared memory "
+        "(zero syscalls/copies on the wire), amqp targets a RabbitMQ broker",
     )
     p.add_argument(
         "--listen", type=str, default="127.0.0.1:7777",
         help="host:port for --transport socket",
+    )
+    p.add_argument(
+        "--shm-name", type=str, default=None,
+        help="shared-memory lane name for --transport shm (default "
+        "tpu-dota-<pid>; actors connect with --connect shm://NAME)",
+    )
+    p.add_argument(
+        "--wire-dtype", type=str, default=None,
+        choices=("float32", "bfloat16"),
+        help="weights fanout wire dtype (overrides transport.wire_dtype); "
+        "bfloat16 halves fanout bytes, actors upcast on apply",
     )
     p.add_argument(
         "--amqp-host", type=str, default="localhost",
@@ -1247,13 +1263,38 @@ def main(argv=None) -> Dict[str, float]:
             config, env=dataclasses.replace(config.env, **env_over)
         )
 
+    if args.wire_dtype is not None:
+        config = dataclasses.replace(
+            config, transport=dataclasses.replace(
+                config.transport, wire_dtype=args.wire_dtype
+            )
+        )
+
     transport = None
     if args.transport == "socket":
         from dotaclient_tpu.transport.socket_transport import TransportServer
 
         host, port = args.listen.rsplit(":", 1)
-        transport = TransportServer(host, int(port))
+        transport = TransportServer(
+            host, int(port),
+            fanout_max_lag=config.transport.fanout_max_lag,
+        )
         print(f"learner: listening for actors on {transport.address}", flush=True)
+    elif args.transport == "shm":
+        from dotaclient_tpu.transport.shm_transport import ShmTransportServer
+
+        transport = ShmTransportServer(
+            name=args.shm_name,
+            slots=config.transport.shm_slots,
+            ring_bytes=config.transport.shm_ring_bytes,
+            weights_bytes=config.transport.shm_weights_bytes,
+        )
+        print(
+            f"learner: shm lane {transport.address!r} "
+            f"({transport.slots} actor slots; actors: "
+            f"--connect shm://{transport.address})",
+            flush=True,
+        )
     elif args.transport == "amqp":
         from dotaclient_tpu.transport.queues import AmqpTransport
 
@@ -1274,10 +1315,19 @@ def main(argv=None) -> Dict[str, float]:
     )
     from dotaclient_tpu.utils.profiling import trace
 
-    with trace(args.profile):
-        stats = learner.train(
-            args.steps, overlap=args.overlap, refresh_every=args.refresh_every
-        )
+    try:
+        with trace(args.profile):
+            stats = learner.train(
+                args.steps, overlap=args.overlap,
+                refresh_every=args.refresh_every,
+            )
+    finally:
+        if transport is not None and hasattr(transport, "close"):
+            # deterministic teardown even when train() raises: the shm
+            # server unlinks its segments (the resource tracker would
+            # otherwise warn "leaked" at exit), the socket server closes
+            # its listener and connections
+            transport.close()
     print(
         f"done: {stats['optimizer_steps']:.0f} steps, "
         f"{stats['frames_trained']:.0f} frames, "

@@ -9,6 +9,10 @@ from dotaclient_tpu.transport.socket_transport import (
     SocketTransport,
     TransportServer,
 )
+from dotaclient_tpu.transport.shm_transport import (
+    ShmTransport,
+    ShmTransportServer,
+)
 from dotaclient_tpu.transport.serialize import (
     decode_rollout,
     decode_rollout_bytes,
@@ -25,6 +29,8 @@ from dotaclient_tpu.transport.serialize import (
 __all__ = [
     "AmqpTransport",
     "InProcTransport",
+    "ShmTransport",
+    "ShmTransportServer",
     "SocketTransport",
     "Transport",
     "TransportServer",

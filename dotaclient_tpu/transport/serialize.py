@@ -169,12 +169,14 @@ def decode_rollout_bytes(
     The learner-ingest fast path: with the native library built (see
     ``dotaclient_tpu.native``), one C pass locates every tensor and the
     arrays are materialized as zero-copy ``np.frombuffer`` views into
-    ``payload``; otherwise falls back to python-protobuf. Views are
+    ``payload``; otherwise falls back to python-protobuf. ``payload`` may
+    be bytes OR a read-only buffer (the shm lane hands memoryview slices
+    of its drain snapshots — no copy on the way in either). Views are
     read-only — callers that mutate must copy (the trajectory buffer only
     uploads, so the hot path never does).
     """
-    if not isinstance(payload, bytes):
-        payload = bytes(payload)  # bytes-like in (e.g. encoder memoryview)
+    if not isinstance(payload, (bytes, bytearray, memoryview)):
+        payload = bytes(payload)  # exotic bytes-like in
     if native:
         from dotaclient_tpu.native.build import (
             RolloutHeader,
@@ -184,10 +186,17 @@ def decode_rollout_bytes(
 
         lib = load_library()
         if lib is not None:
+            if isinstance(payload, bytes):
+                src = payload          # c_void_p accepts bytes directly
+            else:
+                # raw pointer into the buffer — kept alive by `payload`
+                src = ctypes.c_void_p(
+                    np.frombuffer(payload, np.uint8).ctypes.data
+                )
             hdr = RolloutHeader()
             entries = _entry_buffer()
             n = lib.dota_decode_rollout(
-                payload, len(payload), ctypes.byref(hdr),
+                src, len(payload), ctypes.byref(hdr),
                 entries.ctypes.data_as(ctypes.POINTER(TensorEntry)),
                 _MAX_TENSORS,
             )
@@ -198,8 +207,8 @@ def decode_rollout_bytes(
                     name_off, name_len, dtype_off, dtype_len,
                     data_off, data_len, shape, ndim,
                 ) in entries[:n].tolist():
-                    name = payload[name_off:name_off + name_len].decode()
-                    dkey = payload[dtype_off:dtype_off + dtype_len]
+                    name = bytes(payload[name_off:name_off + name_len]).decode()
+                    dkey = bytes(payload[dtype_off:dtype_off + dtype_len])
                     dtype = _DTYPE_CACHE.get(dkey)
                     if dtype is None:
                         dtype = _np_dtype(dkey.decode())
@@ -221,7 +230,9 @@ def decode_rollout_bytes(
                 return meta, unflatten_tree(flat)
             # n == -2 (too many tensors) or malformed: fall through
     r = pb.Rollout()
-    r.ParseFromString(payload)
+    r.ParseFromString(
+        payload if isinstance(payload, bytes) else bytes(payload)
+    )
     return decode_rollout(r)
 
 
@@ -325,13 +336,75 @@ def encode_rollout_bytes(
     ).SerializeToString()
 
 
-def encode_weights(params: Any, version: int) -> pb.ModelWeights:
+# In-band wire-narrowing marker (the ModelWeights schema predates
+# wire_dtype and protoc is unavailable in this image to extend it): a
+# pseudo-entry in the params map whose ``data`` lists exactly the leaf
+# names the encoder cast f32→bf16, newline-joined. Decode upcasts ONLY
+# those — a natively-bf16 param (model.param_dtype="bfloat16") is never
+# silently widened. The "/"-free dunder name cannot collide with real
+# leaves (flax param paths always nest at least one module level).
+_WIRE_CAST_MARKER = "__wire_cast__"
+
+
+def encode_weights(
+    params: Any, version: int, wire_dtype: str = "float32"
+) -> pb.ModelWeights:
+    """Serialize a param pytree for the weights fanout.
+
+    ``wire_dtype="bfloat16"`` casts float32 leaves to bf16 at encode —
+    half the fanout bytes per publish (TransportConfig.wire_dtype); the
+    decode side upcasts exactly those leaves on apply (recorded in an
+    in-band marker entry). Non-f32 leaves (int counters, natively-bf16
+    params) pass through unchanged in both directions.
+    """
+    if wire_dtype not in ("float32", "bfloat16"):
+        raise ValueError(f"unknown wire_dtype {wire_dtype!r}")
+    cast = None
+    if wire_dtype == "bfloat16":
+        if _BFLOAT16 is None:
+            raise ValueError("wire_dtype=bfloat16 but ml_dtypes unavailable")
+        cast = _BFLOAT16
     msg = pb.ModelWeights(version=version)
+    cast_names = []
     for name, arr in flatten_tree(params).items():
-        msg.params[name].CopyFrom(tensor_to_proto(np.asarray(arr)))
+        a = np.asarray(arr)
+        if cast is not None and a.dtype == np.float32:
+            a = a.astype(cast)
+            cast_names.append(name)
+        msg.params[name].CopyFrom(tensor_to_proto(a))
+    if cast_names:
+        msg.params[_WIRE_CAST_MARKER].CopyFrom(
+            pb.TensorProto(dtype="marker", data="\n".join(cast_names).encode())
+        )
     return msg
 
 
-def decode_weights(msg: pb.ModelWeights) -> Tuple[int, Any]:
-    flat = {name: proto_to_tensor(t) for name, t in msg.params.items()}
+def decode_weights(msg: pb.ModelWeights, upcast: bool = True) -> Tuple[int, Any]:
+    """Decode a weights fanout message → ``(version, param pytree)``.
+
+    With ``upcast`` (the apply-side default) the leaves the encoder
+    narrowed to bf16 come back as float32 — the lossless inverse of the
+    ``wire_dtype="bfloat16"`` cast (every bf16 value is exactly
+    representable in f32). Leaves that were bf16 BEFORE encode carry no
+    marker and keep their dtype. ``upcast=False`` returns the raw wire
+    dtypes (tests, inspection)."""
+    cast_names = frozenset()
+    # `in` before indexing: protobuf message-map __getitem__ auto-inserts
+    if _WIRE_CAST_MARKER in msg.params:
+        cast_names = frozenset(
+            msg.params[_WIRE_CAST_MARKER].data.decode().split("\n")
+        )
+    flat = {}
+    for name, t in msg.params.items():
+        if name == _WIRE_CAST_MARKER:
+            continue
+        arr = proto_to_tensor(t)
+        if (
+            upcast
+            and name in cast_names
+            and _BFLOAT16 is not None
+            and arr.dtype == _BFLOAT16
+        ):
+            arr = arr.astype(np.float32)
+        flat[name] = arr
     return msg.version, unflatten_tree(flat)

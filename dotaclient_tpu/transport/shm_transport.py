@@ -1,0 +1,686 @@
+"""Same-host shared-memory transport: zero-syscall experience + weights.
+
+The dominant split topology runs the rollout-worker processes ON the
+learner host (one TPU host, N CPU actor processes — SURVEY.md §1, §2.3
+row 1). Shipping bytes through loopback TCP there pays two kernel copies,
+a syscall per send/recv, and a reader thread per connection for data that
+never leaves the machine. This module implements the same ``Transport``
+protocol over POSIX shared memory instead (ISSUE 3):
+
+* **rollout lane** — one single-producer/single-consumer byte ring per
+  actor slot. The producer (actor) writes ``u32 length + payload`` frames
+  and bumps a cumulative ``tail``; the consumer (learner) copies frames
+  out and bumps ``head``. No locks: SPSC with cumulative 8-byte counters
+  (written only by their owning side) needs none. A full ring drops the
+  NEW frame (counted in the ring header — the actor must never block on a
+  slow learner; cf. the socket path's drop-oldest).
+* **weights lane** — one seqlock'd slab. ``publish_weights`` bumps the
+  sequence word to odd, writes version + payload, bumps it back to even;
+  readers retry on a torn read (seq changed / odd). Writers never wait for
+  readers and readers never wait for writers — latest-wins by
+  construction, with none of the fanout's per-connection sends.
+
+Segment layout (name = the lane's address, passed to both sides):
+
+    <name>-w                weights slab:
+        [0..8)   seq   u64  (odd while the server writes)
+        [8..16)  version i64
+        [16..24) length  u64
+        [32..)   payload
+    <name>-r<i>  i ∈ [0, slots)   rollout ring per actor slot:
+        [0..8)   head  u64  cumulative bytes consumed  (learner-owned)
+        [8..16)  tail  u64  cumulative bytes written   (actor-owned)
+        [16..24) frames u64 cumulative frames written  (actor-owned)
+        [24..32) dropped u64 frames dropped ring-full  (actor-owned)
+        [32..40) claim u64  owning actor pid, 0 = free
+        [64..)   data (ring_bytes)
+
+Slot claim: an actor scans the rings and claims a free one through an
+``O_CREAT|O_EXCL`` lockfile next to the segments (atomic on the
+filesystem — two actors racing the same slot cannot both win), then
+writes its pid into the ring's claim word for observability. Both are
+released on close; the server reaps slots whose claiming pid is gone
+(crashed actors never run ``close()``), so a supervisor-restarted fleet
+cannot leak slots. Actors and learner must share a filesystem namespace
+(/dev/shm) — same host, the lane's whole point.
+
+Python 3.10's ``SharedMemory`` registers attachments with the resource
+tracker as if it owned them, which would unlink live segments when an
+actor exits; attachments here are explicitly unregistered (the server —
+the creator — is the only unlinker).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import time
+from multiprocessing import resource_tracker, shared_memory
+from typing import List, Optional, Tuple
+
+from dotaclient_tpu.protos import dota_pb2 as pb
+from dotaclient_tpu.utils import telemetry
+
+_U64 = struct.Struct("<Q")
+_I64 = struct.Struct("<q")
+_U32 = struct.Struct("<I")
+_TAIL_FRAMES = struct.Struct("<QQ")   # adjacent tail+frames header words
+
+_OFF_HEAD = 0
+_OFF_TAIL = 8
+_OFF_FRAMES = 16
+_OFF_DROPPED = 24
+_OFF_CLAIM = 32
+_RING_HDR = 64
+
+_OFF_SEQ = 0
+_OFF_VERSION = 8
+_OFF_LENGTH = 16
+_OFF_SERVER_PID = 24   # liveness beacon: actors probe it (same host)
+_SLAB_HDR = 32
+
+# Slot-claim lockfiles live next to the segments. SharedMemory maps names
+# into /dev/shm on Linux; the lockfile's O_CREAT|O_EXCL creation is the
+# atomic mutex the claim-word write alone cannot provide.
+_SHM_DIR = "/dev/shm"
+
+
+def _lock_path(name: str, slot: int) -> str:
+    return os.path.join(_SHM_DIR, f"{name}-claim{slot}")
+
+
+def _try_lock_slot(name: str, slot: int) -> bool:
+    """Atomically claim slot ``slot`` (O_EXCL). False if already claimed.
+    The claimant's pid is written INTO the lockfile so the server's reaper
+    can recognize a claimant that died before (or after) publishing its
+    pid in the ring's claim word."""
+    try:
+        fd = os.open(
+            _lock_path(name, slot),
+            os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644,
+        )
+    except FileExistsError:
+        return False
+    try:
+        os.write(fd, str(os.getpid()).encode())
+    finally:
+        os.close(fd)
+    return True
+
+
+def _lockfile_pid(name: str, slot: int) -> "int | None":
+    """Pid recorded in the slot's lockfile; None if unreadable/empty."""
+    try:
+        with open(_lock_path(name, slot), "rb") as f:
+            return int(f.read().strip() or b"0") or None
+    except (OSError, ValueError):
+        return None
+
+
+def _unlock_slot(name: str, slot: int) -> None:
+    try:
+        os.unlink(_lock_path(name, slot))
+    except FileNotFoundError:
+        pass
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:  # pragma: no cover - exists under another uid
+        return True
+    return True
+
+
+def _reclaim_stale_lane(name: str) -> None:
+    """Unlink lane ``name``'s segments iff its server pid beacon is dead.
+
+    Raises FileExistsError when a LIVE server still owns the lane — the
+    caller must not steal it."""
+    try:
+        slab = _attach(f"{name}-w")
+    except FileNotFoundError:
+        return   # only rings/locks linger: fall through to ring reclaim
+    else:
+        pid = _U64.unpack_from(slab.buf, _OFF_SERVER_PID)[0]
+        alive = bool(pid) and _pid_alive(int(pid))
+        try:
+            if not alive:
+                slab.unlink()
+            slab.close()
+        except (OSError, BufferError):  # pragma: no cover
+            pass
+        if alive:
+            raise FileExistsError(
+                f"shm lane {name!r} is owned by live learner pid {pid}"
+            )
+    i = 0
+    while True:
+        try:
+            seg = _attach(f"{name}-r{i}")
+        except FileNotFoundError:
+            break
+        try:
+            seg.unlink()
+            seg.close()
+        except (OSError, BufferError):  # pragma: no cover
+            pass
+        _unlock_slot(name, i)
+        i += 1
+
+
+# Segment names created by THIS process's servers: a same-process attach
+# (tests, single-process topologies) shares the creator's tracker cache
+# entry, and unregistering it would make the creator's unlink double-free.
+_OWNED_BY_THIS_PROCESS: set = set()
+
+
+def _attach(name: str) -> shared_memory.SharedMemory:
+    shm = shared_memory.SharedMemory(name=name)
+    if name not in _OWNED_BY_THIS_PROCESS:
+        try:
+            # 3.10 registers attachments like creations; without this the
+            # attaching process unlinks live segments at exit
+            resource_tracker.unregister(shm._name, "shared_memory")
+        except Exception:  # pragma: no cover - tracker internals shifted
+            pass
+    return shm
+
+
+def _ring_write(mv: memoryview, ring_bytes: int, pos: int, data) -> None:
+    """Copy ``data`` into the ring data region at logical position ``pos``
+    (mod ring_bytes), splitting across the wrap edge when needed."""
+    pos %= ring_bytes
+    end = pos + len(data)
+    if end <= ring_bytes:
+        mv[_RING_HDR + pos:_RING_HDR + end] = data
+    else:
+        k = ring_bytes - pos
+        mv[_RING_HDR + pos:_RING_HDR + ring_bytes] = data[:k]
+        mv[_RING_HDR:_RING_HDR + end - ring_bytes] = data[k:]
+
+
+def _ring_read(mv: memoryview, ring_bytes: int, pos: int, n: int) -> bytes:
+    pos %= ring_bytes
+    end = pos + n
+    if end <= ring_bytes:
+        return bytes(mv[_RING_HDR + pos:_RING_HDR + end])
+    k = ring_bytes - pos
+    return bytes(mv[_RING_HDR + pos:_RING_HDR + ring_bytes]) + bytes(
+        mv[_RING_HDR:_RING_HDR + end - ring_bytes]
+    )
+
+
+class ShmTransportServer:
+    """Learner side: create the segments, drain every claimed ring."""
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        slots: int = 16,
+        ring_bytes: int = 8 * 1024 * 1024,
+        weights_bytes: int = 32 * 1024 * 1024,
+    ) -> None:
+        if slots < 1:
+            raise ValueError("shm transport needs at least one actor slot")
+        self.name = name or f"tpu-dota-{os.getpid()}"
+        self.address = self.name
+        self.slots = slots
+        self.ring_bytes = ring_bytes
+        try:
+            self._weights = shared_memory.SharedMemory(
+                name=f"{self.name}-w", create=True,
+                size=_SLAB_HDR + weights_bytes,
+            )
+        except FileExistsError:
+            # fixed --shm-name + a SIGKILL'd previous learner leaves stale
+            # segments: reclaim them iff their pid beacon is dead — a
+            # supervisor restart must not crash-loop on its own leftovers
+            _reclaim_stale_lane(self.name)
+            self._weights = shared_memory.SharedMemory(
+                name=f"{self.name}-w", create=True,
+                size=_SLAB_HDR + weights_bytes,
+            )
+        _OWNED_BY_THIS_PROCESS.add(f"{self.name}-w")
+        self._rings = []
+        try:
+            for i in range(slots):
+                self._rings.append(
+                    shared_memory.SharedMemory(
+                        name=f"{self.name}-r{i}", create=True,
+                        size=_RING_HDR + ring_bytes,
+                    )
+                )
+                _OWNED_BY_THIS_PROCESS.add(f"{self.name}-r{i}")
+        except OSError:
+            # partial creation (ENOSPC on a tight /dev/shm, stale ring):
+            # unlink what was created — a failed constructor must not
+            # poison the name or leak tmpfs pages until reboot
+            for seg in (self._weights, *self._rings):
+                try:
+                    seg.unlink()
+                    seg.close()
+                except (OSError, FileNotFoundError):
+                    pass
+            _OWNED_BY_THIS_PROCESS.discard(f"{self.name}-w")
+            for k in range(slots):
+                _OWNED_BY_THIS_PROCESS.discard(f"{self.name}-r{k}")
+            raise
+        for seg in (self._weights, *self._rings):
+            seg.buf[:_RING_HDR] = bytes(_RING_HDR)  # zeroed headers
+        # liveness beacon: shm has no connection to break, so actors probe
+        # this pid to notice a dead/restarted learner (and then reconnect
+        # with backoff or exit for the supervisor — actor/__main__.py)
+        _U64.pack_into(self._weights.buf, _OFF_SERVER_PID, os.getpid())
+        for i in range(slots):
+            # fresh lane (segment creation above proved no live server owns
+            # this name): any same-name lockfile is a crashed run's leftover
+            _unlock_slot(self.name, i)
+        self._consumed = [0] * slots      # frames drained per ring
+        self._next_ring = 0               # round-robin drain fairness
+        self._last_telemetry = 0.0        # ring-scan gauges are time-gated
+        # Deferred release (the zero-copy contract): a drain hands back
+        # memoryview slices INTO the rings; the freed space is published to
+        # the producers only at the NEXT drain call, by which point the
+        # caller has decoded/staged the previous batch (the learner's
+        # ingest copies rows into the buffer's staging lanes before it
+        # polls again).
+        self._pending_head: List[Optional[int]] = [None] * slots
+        self._latest_weights: Optional[pb.ModelWeights] = None
+        self.bad_payloads = 0
+        self._closed = False
+        self._tel = telemetry.get_registry()
+        # eager-create (schema stability — see socket_transport.py)
+        self._tel.gauge("shm/ring_occupancy")
+        self._tel.gauge("shm/ring_dropped_total")
+        self._tel.gauge("transport/queue_depth")
+
+    # -- rollout lane ------------------------------------------------------
+
+    def _release_pending(self) -> None:
+        """Publish the head positions of the previous drain's frames: their
+        views are consumed by now, so the producers may reuse the space."""
+        for i, h in enumerate(self._pending_head):
+            if h is not None:
+                _U64.pack_into(self._rings[i].buf, _OFF_HEAD, h)
+                self._pending_head[i] = None
+
+    def _drain_ring(
+        self, i: int, budget: int, out: List[memoryview]
+    ) -> None:
+        """Collect every complete frame from ring ``i`` (up to ``budget``
+        total frames in ``out``) as ZERO-COPY memoryview slices into the
+        ring itself — per frame: one length unpack and one slice, no
+        payload copy at all (only a frame that physically wraps the ring
+        edge is copied, at most one per lap). The consumed space is not
+        released here — ``head`` advances at the next drain
+        (``_release_pending``), after the caller has decoded/staged these
+        frames; until then the producer cannot overwrite them."""
+        mv = self._rings[i].buf
+        N = self.ring_bytes
+        head = _U64.unpack_from(mv, _OFF_HEAD)[0]
+        tail = _U64.unpack_from(mv, _OFF_TAIL)[0]
+        if head == tail:
+            return
+        consumed = 0
+        while head < tail and len(out) < budget:
+            pos = head % N
+            if pos + 4 <= N:
+                length = _U32.unpack_from(mv, _RING_HDR + pos)[0]
+            else:
+                length = _U32.unpack(_ring_read(mv, N, pos, 4))[0]
+            dpos = (pos + 4) % N
+            if dpos + length <= N:     # common case: contiguous → view
+                base = _RING_HDR + dpos
+                out.append(mv[base:base + length])
+            else:                      # wraps the edge: one stitch copy
+                out.append(memoryview(_ring_read(mv, N, dpos, length)))
+            head += 4 + length
+            consumed += 1
+        if consumed:
+            self._consumed[i] += consumed
+            self._pending_head[i] = head
+
+    def _drain(
+        self, max_count: int, timeout: Optional[float]
+    ) -> List[memoryview]:
+        out: List[memoryview] = []
+        t0 = time.perf_counter()
+        deadline = None if timeout is None else t0 + timeout
+        self._release_pending()
+        while True:
+            start = self._next_ring
+            for k in range(self.slots):
+                self._drain_ring((start + k) % self.slots, max_count, out)
+            self._next_ring = (start + 1) % self.slots
+            if out or self._closed:
+                break
+            if deadline is not None and time.perf_counter() >= deadline:
+                break
+            time.sleep(0.0005)
+        if out:
+            self._tel.timer("span/transport/consume").observe(
+                time.perf_counter() - t0
+            )
+            self._tel.counter("transport/experience_consumed").inc(len(out))
+        now = time.perf_counter()
+        if now - self._last_telemetry > 0.05:
+            # the full ring scan costs ~a frame of time on slow hosts —
+            # gauges refresh at human cadence, not per drain call
+            self._last_telemetry = now
+            self._publish_ring_telemetry()
+        return out
+
+    def _publish_ring_telemetry(self) -> None:
+        occ = 0.0
+        dropped = 0
+        pending = 0
+        for i, seg in enumerate(self._rings):
+            mv = seg.buf
+            head = _U64.unpack_from(mv, _OFF_HEAD)[0]
+            tail = _U64.unpack_from(mv, _OFF_TAIL)[0]
+            frames = _U64.unpack_from(mv, _OFF_FRAMES)[0]
+            dropped += _U64.unpack_from(mv, _OFF_DROPPED)[0]
+            occ = max(occ, (tail - head) / self.ring_bytes)
+            pending += frames - self._consumed[i]
+            # reap: a crashed actor never runs close(), so its slot would
+            # stay claimed forever and a supervisor-restarted fleet would
+            # exhaust slots. Claiming pids are same-host by construction,
+            # so liveness is one signal-0 probe. (Re-check the claim word
+            # right before unlocking: a fresh claimant may have raced in.)
+            claim = _U64.unpack_from(mv, _OFF_CLAIM)[0]
+            if claim and not _pid_alive(int(claim)):
+                if _U64.unpack_from(mv, _OFF_CLAIM)[0] == claim:
+                    _U64.pack_into(mv, _OFF_CLAIM, 0)
+                    _unlock_slot(self.name, i)
+                    self._tel.counter("shm/slots_reaped").inc()
+            elif not claim and os.path.exists(_lock_path(self.name, i)):
+                # claimant died in the window between creating its lockfile
+                # and publishing its pid in the claim word — the lockfile's
+                # own pid record covers it (an unreadable/empty file gets a
+                # grace period: a LIVE claimant may be mid-write)
+                pid = _lockfile_pid(self.name, i)
+                if pid is not None:
+                    if not _pid_alive(pid):
+                        _unlock_slot(self.name, i)
+                        self._tel.counter("shm/slots_reaped").inc()
+                else:
+                    try:
+                        age = time.time() - os.path.getmtime(
+                            _lock_path(self.name, i)
+                        )
+                    except OSError:
+                        age = 0.0
+                    if age > 5.0:
+                        _unlock_slot(self.name, i)
+                        self._tel.counter("shm/slots_reaped").inc()
+        self._tel.gauge("shm/ring_occupancy").set(occ)
+        self._tel.gauge("shm/ring_dropped_total").set(float(dropped))
+        self._tel.gauge("transport/queue_depth").set(float(pending))
+
+    def consume_rollouts(
+        self, max_count: int, timeout: Optional[float] = None
+    ) -> List[pb.Rollout]:
+        protos = []
+        for payload in self._drain(max_count, timeout):
+            r = pb.Rollout()
+            try:
+                r.ParseFromString(payload)
+            except Exception:
+                self.bad_payloads += 1
+                continue
+            protos.append(r)
+        return protos
+
+    def consume_decoded(self, max_count: int, timeout: Optional[float] = None):
+        from dotaclient_tpu.transport.serialize import decode_rollout_bytes
+
+        out = []
+        for p in self._drain(max_count, timeout):
+            try:
+                out.append(decode_rollout_bytes(p))
+            except Exception:
+                self.bad_payloads += 1
+        return out
+
+    # -- weights lane ------------------------------------------------------
+
+    def publish_weights(self, weights: pb.ModelWeights) -> None:
+        payload = weights.SerializeToString()
+        mv = self._weights.buf
+        cap = self._weights.size - _SLAB_HDR
+        if len(payload) > cap:
+            raise ValueError(
+                f"encoded weights ({len(payload)} bytes) exceed the shm "
+                f"slab ({cap} bytes) — raise transport.shm_weights_bytes"
+            )
+        seq = _U64.unpack_from(mv, _OFF_SEQ)[0]
+        _U64.pack_into(mv, _OFF_SEQ, seq + 1)            # odd: write begins
+        _I64.pack_into(mv, _OFF_VERSION, weights.version)
+        _U64.pack_into(mv, _OFF_LENGTH, len(payload))
+        mv[_SLAB_HDR:_SLAB_HDR + len(payload)] = payload
+        _U64.pack_into(mv, _OFF_SEQ, seq + 2)            # even: stable
+        self._latest_weights = weights
+        self._tel.counter("transport/weights_published").inc()
+        self._tel.gauge("transport/weights_version").set(weights.version)
+        self._tel.gauge("transport/actors_connected").set(self.n_connected)
+
+    def latest_weights(self) -> Optional[pb.ModelWeights]:
+        return self._latest_weights
+
+    def publish_rollout(self, rollout: pb.Rollout) -> None:
+        raise RuntimeError(
+            "ShmTransportServer is the learner side; actors publish"
+        )
+
+    # -- bookkeeping -------------------------------------------------------
+
+    @property
+    def n_connected(self) -> int:
+        n = 0
+        for seg in self._rings:
+            if _U64.unpack_from(seg.buf, _OFF_CLAIM)[0]:
+                n += 1
+        return n
+
+    @property
+    def pending_rollouts(self) -> int:
+        pending = 0
+        for i, seg in enumerate(self._rings):
+            frames = _U64.unpack_from(seg.buf, _OFF_FRAMES)[0]
+            pending += frames - self._consumed[i]
+        return pending
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for seg in (self._weights, *self._rings):
+            try:
+                seg.unlink()
+            except (OSError, FileNotFoundError):
+                pass
+            try:
+                seg.close()
+            except OSError:
+                pass
+            except BufferError:
+                # a caller still holds zero-copy frame views: the mapping
+                # must outlive them (unlink above already removed the
+                # name). Disarm the destructor's re-close so GC does not
+                # print "Exception ignored" noise at teardown.
+                seg.close = lambda: None
+        _OWNED_BY_THIS_PROCESS.discard(f"{self.name}-w")
+        for i in range(self.slots):
+            _OWNED_BY_THIS_PROCESS.discard(f"{self.name}-r{i}")
+            _unlock_slot(self.name, i)   # lane is gone: clear stale locks
+
+
+class ShmTransport:
+    """Actor side: claim a ring slot, publish rollouts, read weights."""
+
+    def __init__(self, name: str, slots: Optional[int] = None) -> None:
+        """Attach to lane ``name``; probe every existing ring segment (the
+        server decides how many exist — ``slots`` only bounds the probe for
+        tests) and claim the first free one via its O_EXCL lockfile."""
+        self.name = name
+        self._weights_shm = _attach(f"{name}-w")
+        # a SIGKILL'd learner leaves its segments behind: attaching them
+        # must fail like a refused TCP connect, or the reconnect loop in
+        # actor/__main__.py would "succeed" against a corpse forever
+        server_pid = _U64.unpack_from(self._weights_shm.buf, _OFF_SERVER_PID)[0]
+        if server_pid and not _pid_alive(int(server_pid)):
+            self._weights_shm.close()
+            raise ConnectionError(
+                f"shm lane {name!r}: learner process {server_pid} is gone"
+            )
+        self._ring: Optional[shared_memory.SharedMemory] = None
+        self.slot = -1
+        pid = os.getpid()
+        i = 0
+        while slots is None or i < slots:
+            try:
+                seg = _attach(f"{name}-r{i}")
+            except FileNotFoundError:
+                break   # past the last ring the server created
+            if _try_lock_slot(name, i):   # atomic: a race has ONE winner
+                _U64.pack_into(seg.buf, _OFF_CLAIM, pid)
+                self._ring = seg
+                self.slot = i
+                break
+            seg.close()
+            i += 1
+        if self._ring is None:
+            self._weights_shm.close()
+            raise ConnectionError(
+                f"no free shm actor slot on lane {name!r} (all claimed)"
+            )
+        self.ring_bytes = self._ring.size - _RING_HDR
+        self._mv = self._ring.buf          # cached: .buf re-wraps per access
+        self._seen_version: Optional[int] = None
+        self._cached: Optional[pb.ModelWeights] = None
+        self._last_liveness = time.monotonic()
+        self._tel = telemetry.get_registry()
+        # Producer-owned header words mirrored as host ints: the producer is
+        # the only writer of tail/frames/dropped, so the hot path never
+        # re-reads them from shared memory (a struct.unpack_from costs µs on
+        # slow hosts — per frame, that is the difference between winning
+        # and losing to loopback TCP).
+        mv = self._ring.buf
+        self._tail = _U64.unpack_from(mv, _OFF_TAIL)[0]
+        self._frames = _U64.unpack_from(mv, _OFF_FRAMES)[0]
+        self._dropped = _U64.unpack_from(mv, _OFF_DROPPED)[0]
+        self._pub_counter = self._tel.counter("transport/experience_published")
+        self._drop_counter = self._tel.counter("transport/experience_dropped")
+
+    def _check_learner_alive(self) -> None:
+        """Shared memory has no connection to break: probe the server's pid
+        beacon (time-gated — one signal-0 every couple of seconds) so a
+        dead/restarted learner surfaces as ConnectionError and the actor's
+        reconnect-with-backoff / exit-for-supervisor machinery engages."""
+        now = time.monotonic()
+        if now - self._last_liveness < 2.0:
+            return
+        self._last_liveness = now
+        pid = _U64.unpack_from(self._weights_shm.buf, _OFF_SERVER_PID)[0]
+        if pid and not _pid_alive(int(pid)):
+            raise ConnectionError(
+                f"shm lane {self.name!r}: learner process {pid} is gone"
+            )
+
+    # -- rollouts ----------------------------------------------------------
+
+    def publish_rollout(self, rollout: pb.Rollout) -> None:
+        self.publish_rollout_bytes(rollout.SerializeToString())
+
+    def publish_rollout_bytes(self, payload) -> bool:
+        """One frame into the SPSC ring; returns False (counted drop) when
+        full — the actor never blocks on a slow learner.
+
+        Hot path: ONE shared-memory read (the consumer-owned ``head``), one
+        payload memcpy, one combined tail+frames header write. Everything
+        the producer owns lives in host ints."""
+        self._check_learner_alive()
+        mv = self._mv
+        N = self.ring_bytes
+        n = len(payload)
+        need = 4 + n
+        if need > N:
+            raise ValueError(
+                f"rollout frame ({need} bytes) exceeds the shm ring "
+                f"({N} bytes) — raise transport.shm_ring_bytes"
+            )
+        tail = self._tail
+        head = _U64.unpack_from(mv, _OFF_HEAD)[0]
+        if need > N - (tail - head):
+            self._dropped += 1
+            _U64.pack_into(mv, _OFF_DROPPED, self._dropped)
+            self._drop_counter.inc()
+            return False
+        pos = tail % N
+        if pos + need <= N:        # common case: no wrap, two direct slices
+            base = _RING_HDR + pos
+            _U32.pack_into(mv, base, n)
+            mv[base + 4:base + 4 + n] = payload
+        else:
+            _ring_write(mv, N, pos, _U32.pack(n))
+            _ring_write(mv, N, pos + 4, payload)
+        # tail moves only after the payload is in place: the consumer never
+        # sees a half-written frame (tail and frames are adjacent — one
+        # packed write publishes both)
+        self._tail = tail + need
+        self._frames += 1
+        _TAIL_FRAMES.pack_into(mv, _OFF_TAIL, self._tail, self._frames)
+        self._pub_counter.inc()
+        return True
+
+    # -- weights -----------------------------------------------------------
+
+    def latest_weights(self) -> Optional[pb.ModelWeights]:
+        self._check_learner_alive()
+        mv = self._weights_shm.buf
+        for _ in range(64):   # seqlock retry budget; writes are µs-scale
+            s1 = _U64.unpack_from(mv, _OFF_SEQ)[0]
+            if s1 == 0:
+                return None          # nothing published yet
+            if s1 & 1:
+                time.sleep(0.0002)   # server mid-write
+                continue
+            version = _I64.unpack_from(mv, _OFF_VERSION)[0]
+            if version == self._seen_version:
+                return self._cached  # no re-parse for an unchanged slab
+            length = _U64.unpack_from(mv, _OFF_LENGTH)[0]
+            payload = bytes(mv[_SLAB_HDR:_SLAB_HDR + length])
+            if _U64.unpack_from(mv, _OFF_SEQ)[0] != s1:
+                continue             # torn read: writer raced us, retry
+            msg = pb.ModelWeights()
+            msg.ParseFromString(payload)
+            self._seen_version = version
+            self._cached = msg
+            return msg
+        return self._cached
+
+    def consume_rollouts(
+        self, max_count: int, timeout: Optional[float] = None
+    ) -> List[pb.Rollout]:
+        raise RuntimeError("ShmTransport is the actor side; learner consumes")
+
+    def publish_weights(self, weights: pb.ModelWeights) -> None:
+        raise RuntimeError("actors do not publish weights")
+
+    def close(self) -> None:
+        if self._ring is not None:
+            try:
+                _U64.pack_into(self._ring.buf, _OFF_CLAIM, 0)  # release slot
+                self._mv = None
+                self._ring.close()
+            except (OSError, ValueError, BufferError):
+                pass
+            _unlock_slot(self.name, self.slot)
+            self._ring = None
+        try:
+            self._weights_shm.close()
+        except (OSError, ValueError):
+            pass

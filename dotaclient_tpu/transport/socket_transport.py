@@ -11,28 +11,48 @@ Wire format per frame: 1 byte kind (0 = Rollout, 1 = ModelWeights) +
 4 bytes big-endian payload length + payload bytes.
 
 * ``TransportServer`` — learner side. Owns the listening socket; every
-  connected actor's rollouts funnel into one internal queue (work-queue
-  semantics), and each ``publish_weights`` is fanned out to every connection
-  (latest-wins on the actor side). Implements the ``Transport`` protocol so
-  the learner uses it exactly like ``InProcTransport``.
+  connected actor's rollouts funnel into one internal deque (work-queue
+  semantics), and ``publish_weights`` fans out to every connection.
+  Implements the ``Transport`` protocol so the learner uses it exactly like
+  ``InProcTransport``.
 * ``SocketTransport`` — actor side. Connects out, publishes rollouts,
   tracks the latest weights broadcast.
+
+Fanout threading model (ISSUE 3): ``publish_weights`` never writes a
+socket. It serializes ONCE, stamps a publish sequence number, and assigns
+the shared payload to each connection's latest-wins slot — an O(1) enqueue
+per connection. A dedicated writer thread per connection drains its slot
+(vectored header+payload send); publishes that land while a send is still
+in flight overwrite the unsent slot (counted in
+``transport/weights_coalesced`` — actors only ever want the latest
+version, and IMPACT's bounded-staleness result licenses skipping
+intermediates). A connection whose writer is still stuck when
+``fanout_max_lag`` newer publishes have been enqueued is over-budget:
+it is dropped and counted (``transport/fanout_conns_dropped``), never
+waited on — one stalled actor cannot delay the learner or its peers.
+
+Ingest is batched (ISSUE 3): each reader thread ``recv_into``s a
+preallocated buffer, parses every complete frame out of it per wakeup, and
+hands the whole batch to the shared deque under one lock — no per-frame
+queue round-trip. ``consume_decoded`` then drains all ready frames in one
+lock acquisition and decodes them into zero-copy views that the trajectory
+buffer's staging lanes copy from directly.
 
 Failure model matches the reference's (SURVEY.md §5.3): actors are
 stateless and disposable — a dead connection is dropped silently server-side
 (its in-flight rollouts are lost, exactly like a RMQ consumer crash), and an
-actor that loses the learner exits with an error for the supervisor
-(k8s/systemd) to restart.
+actor that loses the learner exits (after bounded reconnect attempts —
+``actor/__main__.py``) for the supervisor (k8s/systemd) to restart.
 """
 
 from __future__ import annotations
 
-import queue
 import socket
 import struct
 import threading
 import time
-from typing import List, Optional, Tuple
+from collections import deque
+from typing import Deque, List, Optional, Tuple
 
 from dotaclient_tpu.protos import dota_pb2 as pb
 from dotaclient_tpu.utils import telemetry
@@ -41,6 +61,7 @@ _KIND_ROLLOUT = 0
 _KIND_WEIGHTS = 1
 _HEADER = struct.Struct(">BI")
 MAX_FRAME = 512 * 1024 * 1024
+_RECV_CHUNK = 256 * 1024
 
 
 def _send_frame(sock: socket.socket, kind: int, payload) -> None:
@@ -79,27 +100,62 @@ def _recv_frame(sock: socket.socket) -> Optional[Tuple[int, bytes]]:
     return kind, payload
 
 
+class _Conn:
+    """One actor connection: socket + the latest-wins weights slot its
+    writer thread drains. ``sent_seq`` trails ``pending_seq`` while a send
+    is in flight; the gap is the connection's fanout lag."""
+
+    __slots__ = (
+        "sock", "cond", "pending", "pending_seq", "sent_seq", "dead",
+    )
+
+    def __init__(self, sock: socket.socket, seq: int) -> None:
+        self.sock = sock
+        self.cond = threading.Condition()
+        self.pending: Optional[bytes] = None   # latest unsent weights payload
+        self.pending_seq = seq
+        self.sent_seq = seq      # last publish seq fully written to the wire
+        self.dead = False
+
+
 class TransportServer:
     """Learner-side transport: accept actors, merge their experience."""
 
     def __init__(
-        self, host: str = "127.0.0.1", port: int = 0, max_rollouts: int = 4096
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        max_rollouts: int = 4096,
+        fanout_max_lag: int = 8,
     ) -> None:
         self._listener = socket.create_server((host, port))
         self.address = self._listener.getsockname()
-        self._rollouts: "queue.Queue[bytes]" = queue.Queue(max_rollouts)
-        self._conns: List[socket.socket] = []
+        self._max_rollouts = max_rollouts
+        self._fanout_max_lag = max(1, fanout_max_lag)
+        self._rollouts: Deque[bytes] = deque()
+        self._roll_cond = threading.Condition()
+        self._conns: List[_Conn] = []
         self._conns_lock = threading.Lock()
-        # per-connection send locks: the accept-loop's late-joiner weights
-        # frame and publish_weights may target the same socket concurrently,
-        # and interleaved sendall() corrupts the framed stream
-        self._send_locks: dict = {}
         self.bad_payloads = 0
         self._latest_weights: Optional[pb.ModelWeights] = None
+        self._latest_payload: Optional[bytes] = None
+        self._publish_seq = 0
         self._weights_lock = threading.Lock()
         self._closed = threading.Event()
         self.dropped = 0
         self._tel = telemetry.get_registry()
+        # eager-create the fanout metrics: event-driven counters must exist
+        # (at 0) in every snapshot, not only after their first event —
+        # scripts/check_telemetry_schema.py --require-transport pins these
+        for name in (
+            "transport/weights_coalesced",
+            "transport/fanout_conns_dropped",
+            "transport/weights_sent",
+        ):
+            self._tel.counter(name)
+        self._tel.gauge("transport/fanout_lag_max")
+        self._tel.gauge("transport/fanout_queue_depth")
+        self._tel.gauge("transport/actors_connected")
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="transport-accept", daemon=True
         )
@@ -110,78 +166,149 @@ class TransportServer:
     def _accept_loop(self) -> None:
         while not self._closed.is_set():
             try:
-                conn, _addr = self._listener.accept()
+                sock, _addr = self._listener.accept()
             except OSError:
                 return  # listener closed
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._weights_lock:
+                # baseline sent_seq at the CURRENT publish seq: a seq-0
+                # placeholder would read as `seq` publishes of lag and get
+                # a brand-new connection dropped as over-budget by a
+                # racing publish
+                conn = _Conn(sock, self._publish_seq)
+            # ORDER MATTERS: append the connection BEFORE reading the
+            # latest payload. publish_weights writes the payload before it
+            # snapshots the connection list, so either its snapshot
+            # includes this conn (it assigns the slot itself) or this
+            # loop's later read observes the newly written payload — a
+            # publish racing the accept can never be missed by both sides.
             with self._conns_lock:
                 self._conns.append(conn)
-                self._send_locks[conn] = threading.Lock()
-                # late joiner gets the current weights immediately
-                weights = self._latest_weights
-            if weights is not None:
-                if not self._locked_send(
-                    conn, _KIND_WEIGHTS, weights.SerializeToString()
+            with self._weights_lock:
+                payload = self._latest_payload
+                seq = self._publish_seq
+            with conn.cond:
+                if payload is not None and (
+                    conn.pending is None or conn.pending_seq < seq
                 ):
-                    continue
+                    # late joiner: current weights go through its own
+                    # writer — a joiner that never reads can still never
+                    # block this loop. The guard keeps a concurrent
+                    # publish's NEWER assignment from being overwritten
+                    # (its writer thread has not started yet, so an
+                    # assigned slot is still exactly as the publish left
+                    # it).
+                    conn.pending = payload
+                    conn.pending_seq = seq
+                    conn.sent_seq = seq - 1
+                    conn.cond.notify()
             threading.Thread(
                 target=self._reader_loop, args=(conn,),
                 name="transport-reader", daemon=True,
             ).start()
+            threading.Thread(
+                target=self._writer_loop, args=(conn,),
+                name="transport-writer", daemon=True,
+            ).start()
 
-    def _reader_loop(self, conn: socket.socket) -> None:
+    def _reader_loop(self, conn: _Conn) -> None:
+        """Batched ingest: ``recv_into`` a preallocated buffer, parse every
+        complete frame per wakeup, hand the batch over under ONE lock."""
+        recv_buf = bytearray(_RECV_CHUNK)
+        recv_view = memoryview(recv_buf)
+        acc = bytearray()    # partial-frame accumulator across recvs
+        hdr = _HEADER.size
         try:
             while not self._closed.is_set():
-                frame = _recv_frame(conn)
-                if frame is None:
+                n = conn.sock.recv_into(recv_view)
+                if n == 0:
                     break
-                kind, payload = frame
-                if kind != _KIND_ROLLOUT:
-                    continue
-                # raw bytes are queued; parsing happens on the consumer via
-                # the native fast-path decoder (consume_decoded) or protobuf
-                while True:
-                    try:
-                        self._rollouts.put_nowait(payload)
-                        break
-                    except queue.Full:  # drop-oldest backpressure
-                        try:
-                            self._rollouts.get_nowait()
-                            self.dropped += 1
-                            self._tel.counter(
-                                "transport/experience_dropped"
-                            ).inc()
-                        except queue.Empty:
-                            pass
-                self._tel.counter("transport/experience_published").inc()
-                self._tel.gauge("transport/queue_depth").set(
-                    self._rollouts.qsize()
-                )
+                acc += recv_view[:n]
+                frames: List[bytes] = []
+                off = 0
+                # memoryview slices are zero-copy, so bytes() is the ONE
+                # copy per frame (slicing the bytearray directly would
+                # copy twice). Released before the del — a live export
+                # blocks resizing the bytearray.
+                acc_view = memoryview(acc)
+                try:
+                    while len(acc) - off >= hdr:
+                        kind, length = _HEADER.unpack_from(acc, off)
+                        if length > MAX_FRAME:
+                            raise ValueError(
+                                f"frame of {length} bytes exceeds MAX_FRAME"
+                            )
+                        if len(acc) - off - hdr < length:
+                            break   # incomplete tail: wait for more bytes
+                        if kind == _KIND_ROLLOUT:
+                            frames.append(
+                                bytes(acc_view[off + hdr:off + hdr + length])
+                            )
+                        off += hdr + length
+                finally:
+                    acc_view.release()
+                if off:
+                    del acc[:off]
+                if frames:
+                    self._enqueue_rollouts(frames)
         except (OSError, ValueError):
             pass  # dead actor: stateless, just drop it (SURVEY.md §5.3)
         finally:
             self._drop(conn)
 
-    def _locked_send(self, conn: socket.socket, kind: int, payload: bytes) -> bool:
-        with self._conns_lock:
-            lock = self._send_locks.get(conn)
-        if lock is None:
-            return False
-        try:
-            with lock:
-                _send_frame(conn, kind, payload)
-            return True
-        except OSError:
-            self._drop(conn)
-            return False
+    def _enqueue_rollouts(self, frames: List[bytes]) -> None:
+        with self._roll_cond:
+            self._rollouts.extend(frames)
+            over = len(self._rollouts) - self._max_rollouts
+            if over > 0:  # drop-oldest backpressure
+                for _ in range(over):
+                    self._rollouts.popleft()
+                self.dropped += over
+                self._tel.counter("transport/experience_dropped").inc(over)
+            depth = len(self._rollouts)
+            self._roll_cond.notify()
+        self._tel.counter("transport/experience_published").inc(len(frames))
+        self._tel.gauge("transport/queue_depth").set(depth)
 
-    def _drop(self, conn: socket.socket) -> None:
+    def _writer_loop(self, conn: _Conn) -> None:
+        """Per-connection weights writer: drain the latest-wins slot. Only
+        this thread ever writes ``conn.sock``, so no send lock exists."""
+        while True:
+            with conn.cond:
+                while (
+                    conn.pending is None
+                    and not conn.dead
+                    and not self._closed.is_set()
+                ):
+                    conn.cond.wait(0.5)
+                if conn.dead or self._closed.is_set():
+                    return
+                payload, seq = conn.pending, conn.pending_seq
+                conn.pending = None
+            try:
+                _send_frame(conn.sock, _KIND_WEIGHTS, payload)
+            except (OSError, ValueError):
+                self._drop(conn)
+                return
+            conn.sent_seq = seq
+            self._tel.counter("transport/weights_sent").inc()
+
+    def _drop(self, conn: _Conn) -> None:
         with self._conns_lock:
             if conn in self._conns:
                 self._conns.remove(conn)
-            self._send_locks.pop(conn, None)
+        with conn.cond:
+            conn.dead = True
+            conn.pending = None
+            conn.cond.notify_all()
         try:
-            conn.close()
+            # shutdown (not just close) unblocks a writer stuck in sendall
+            # on a stalled consumer's full socket buffer
+            conn.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            conn.sock.close()
         except OSError:
             pass
 
@@ -195,18 +322,24 @@ class TransportServer:
         # timeouts measure idle waiting, not drain cost (see queues.py)
         out: List[bytes] = []
         t0 = time.perf_counter()
-        try:
-            out.append(self._rollouts.get(timeout=timeout))
-        except queue.Empty:
-            return out
-        while len(out) < max_count:
-            try:
-                out.append(self._rollouts.get_nowait())
-            except queue.Empty:
-                break
+        deadline = None if timeout is None else t0 + timeout
+        with self._roll_cond:
+            while not self._rollouts:
+                if self._closed.is_set():
+                    return out
+                remaining = (
+                    None if deadline is None
+                    else deadline - time.perf_counter()
+                )
+                if remaining is not None and remaining <= 0:
+                    return out
+                self._roll_cond.wait(remaining)
+            while self._rollouts and len(out) < max_count:
+                out.append(self._rollouts.popleft())
+            depth = len(self._rollouts)
         self._tel.timer("span/transport/consume").observe(time.perf_counter() - t0)
         self._tel.counter("transport/experience_consumed").inc(len(out))
-        self._tel.gauge("transport/queue_depth").set(self._rollouts.qsize())
+        self._tel.gauge("transport/queue_depth").set(depth)
         return out
 
     def consume_rollouts(
@@ -226,8 +359,10 @@ class TransportServer:
     def consume_decoded(self, max_count: int, timeout: Optional[float] = None):
         """Drain as decoded (meta, arrays) pairs via the native fast-path
         wire parser — the learner-ingest hot path (SURVEY.md §2.2 row 3).
-        Malformed payloads (version-skewed actors, port scanners) are counted
-        and dropped — the disposable-actor failure model, SURVEY.md §5.3."""
+        The arrays are zero-copy views into the wire payloads; the buffer's
+        staging lanes copy straight out of them (its only copy). Malformed
+        payloads (version-skewed actors, port scanners) are counted and
+        dropped — the disposable-actor failure model, SURVEY.md §5.3."""
         from dotaclient_tpu.transport.serialize import decode_rollout_bytes
 
         out = []
@@ -239,15 +374,45 @@ class TransportServer:
         return out
 
     def publish_weights(self, weights: pb.ModelWeights) -> None:
+        """Non-blocking fanout: serialize once, assign the shared payload to
+        every connection's latest-wins slot, drop over-budget connections.
+        Never writes a socket — returns in O(connections) slot assignments
+        regardless of how stalled any consumer is."""
         payload = weights.SerializeToString()
         with self._weights_lock:
             self._latest_weights = weights
+            self._latest_payload = payload
+            self._publish_seq += 1
+            seq = self._publish_seq
         with self._conns_lock:
             conns = list(self._conns)
+        over_budget: List[_Conn] = []
+        max_lag = 0
+        pending_depth = 0
         for conn in conns:
-            self._locked_send(conn, _KIND_WEIGHTS, payload)
+            with conn.cond:
+                if conn.pending is not None:
+                    # a send is still in flight and an unsent older version
+                    # just became worthless: latest wins
+                    self._tel.counter("transport/weights_coalesced").inc()
+                    pending_depth += 1
+                conn.pending = payload
+                conn.pending_seq = seq
+                conn.cond.notify()
+            lag = seq - conn.sent_seq
+            max_lag = max(max_lag, lag)
+            if lag > self._fanout_max_lag:
+                over_budget.append(conn)
+        for conn in over_budget:
+            # stalled past the budget: cut it loose (counted), never wait
+            self._tel.counter("transport/fanout_conns_dropped").inc()
+            self._drop(conn)
         self._tel.counter("transport/weights_published").inc()
         self._tel.gauge("transport/weights_version").set(weights.version)
+        self._tel.gauge("transport/fanout_lag_max").set(float(max_lag))
+        self._tel.gauge("transport/fanout_queue_depth").set(
+            float(pending_depth)
+        )
         self._tel.gauge("transport/actors_connected").set(self.n_connected)
 
     def latest_weights(self) -> Optional[pb.ModelWeights]:
@@ -261,7 +426,8 @@ class TransportServer:
 
     @property
     def pending_rollouts(self) -> int:
-        return self._rollouts.qsize()
+        with self._roll_cond:
+            return len(self._rollouts)
 
     def close(self) -> None:
         self._closed.set()
@@ -270,12 +436,22 @@ class TransportServer:
         except OSError:
             pass
         with self._conns_lock:
-            for conn in self._conns:
-                try:
-                    conn.close()
-                except OSError:
-                    pass
+            conns = list(self._conns)
             self._conns.clear()
+        for conn in conns:
+            with conn.cond:
+                conn.dead = True
+                conn.cond.notify_all()
+            try:
+                conn.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+        with self._roll_cond:
+            self._roll_cond.notify_all()
 
 
 class SocketTransport:

@@ -59,8 +59,29 @@ REQUIRED_KEYS = (
 
 TIMER_LEAVES = ("count", "total_s", "last_s", "mean_s", "ema_s", "p95_s")
 
+# Cross-process transport metrics (ISSUE 3). Not in REQUIRED_KEYS: a --smoke
+# run uses the in-proc transport and legitimately never emits them. A run
+# that DID use the socket/shm transport validates them via
+# --require-transport / --require-shm (the servers eager-create every one of
+# these at construction, so presence is deterministic, not event-driven).
+SOCKET_TRANSPORT_KEYS = (
+    "transport/weights_coalesced",      # unsent frame replaced: latest wins
+    "transport/fanout_conns_dropped",   # over-budget conns cut loose
+    "transport/weights_sent",           # frames fully written to a wire
+    "transport/fanout_lag_max",         # worst conn publish-seq lag
+    "transport/fanout_queue_depth",     # conns with an unsent frame
+    "transport/actors_connected",
+)
+SHM_TRANSPORT_KEYS = (
+    "shm/ring_occupancy",               # max ring fill fraction
+    "shm/ring_dropped_total",           # producer-side ring-full drops
+    "transport/queue_depth",
+)
 
-def validate_lines(lines: List[str]) -> List[str]:
+
+def validate_lines(
+    lines: List[str], extra_required: tuple = ()
+) -> List[str]:
     """Return a list of violations (empty = schema holds)."""
     errors: List[str] = []
     union: Dict[str, object] = {}
@@ -89,7 +110,9 @@ def validate_lines(lines: List[str]) -> List[str]:
             elif v is not None and not isinstance(v, (int, float)):
                 errors.append(f"line {i}: scalar {k!r} is {type(v).__name__}")
         union.update(scalars)
-    missing = [k for k in REQUIRED_KEYS if k not in union]
+    missing = [
+        k for k in (*REQUIRED_KEYS, *extra_required) if k not in union
+    ]
     if missing:
         errors.append(
             "required telemetry keys never emitted: " + ", ".join(missing)
@@ -123,7 +146,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--path", type=str, default=None,
         help="validate an existing JSONL file instead of running the smoke",
     )
+    p.add_argument(
+        "--require-transport", action="store_true",
+        help="also require the socket-transport fanout metrics (for "
+        "validating a --transport socket run's JSONL)",
+    )
+    p.add_argument(
+        "--require-shm", action="store_true",
+        help="also require the shared-memory lane metrics (for validating "
+        "a --transport shm run's JSONL)",
+    )
     args = p.parse_args(argv)
+    extra: tuple = ()
+    if args.require_transport:
+        extra += SOCKET_TRANSPORT_KEYS
+    if args.require_shm:
+        extra += SHM_TRANSPORT_KEYS
 
     path = args.path
     if path is None:
@@ -139,7 +177,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         with open(path) as f:
             lines = f.read().splitlines()
 
-    errors = validate_lines(lines)
+    errors = validate_lines(lines, extra_required=extra)
     if errors:
         print("telemetry schema check FAILED:", file=sys.stderr)
         for e in errors:

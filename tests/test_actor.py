@@ -239,3 +239,80 @@ class TestWindowedStats:
         assert s["episodes_recent"] == 2.0
         assert s["win_rate_recent"] == 0.0
         assert s["ep_reward_recent"] == 0.0
+
+
+class TestConnectBackoff:
+    """Actor-process robustness (ISSUE 3 satellite): bounded exponential
+    backoff + jitter around transport (re)connects, counted in
+    transport/reconnects_total."""
+
+    def test_retries_then_succeeds(self):
+        import random
+
+        from dotaclient_tpu.actor.__main__ import connect_with_backoff
+        from dotaclient_tpu.utils import telemetry
+
+        reg = telemetry.get_registry()
+        before = reg.counter("transport/reconnects_total").value
+        sleeps = []
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ConnectionError("learner not up yet")
+            return "transport"
+
+        out = connect_with_backoff(
+            flaky, max_attempts=5, base_delay=0.5,
+            sleep=sleeps.append, rng=random.Random(0),
+        )
+        assert out == "transport"
+        assert calls["n"] == 3
+        # one counted retry per attempt beyond the first
+        assert reg.counter("transport/reconnects_total").value - before == 2
+        # exponential envelope with full jitter: delay k bounded by
+        # base * 2^(k-1), and never negative
+        assert len(sleeps) == 2
+        assert 0.0 <= sleeps[0] <= 0.5
+        assert 0.0 <= sleeps[1] <= 1.0
+
+    def test_bounded_attempts_reraise(self):
+        import random
+
+        from dotaclient_tpu.actor.__main__ import connect_with_backoff
+
+        calls = {"n": 0}
+
+        def dead():
+            calls["n"] += 1
+            raise ConnectionError("gone")
+
+        with pytest.raises(ConnectionError, match="after 3 attempts"):
+            connect_with_backoff(
+                dead, max_attempts=3, sleep=lambda s: None,
+                rng=random.Random(0),
+            )
+        assert calls["n"] == 3
+
+    def test_jitter_desynchronizes_replicas(self):
+        """Two replicas with different seeds must not sleep in lockstep
+        (thundering-herd guard)."""
+        import random
+
+        from dotaclient_tpu.actor.__main__ import connect_with_backoff
+
+        def sleeps_for(seed):
+            sleeps = []
+
+            def dead():
+                raise ConnectionError("gone")
+
+            with pytest.raises(ConnectionError):
+                connect_with_backoff(
+                    dead, max_attempts=4, sleep=sleeps.append,
+                    rng=random.Random(seed),
+                )
+            return sleeps
+
+        assert sleeps_for(1) != sleeps_for(2)

@@ -359,6 +359,65 @@ class TestSchemaChecker:
         errors = checker.validate_lines(['{"ts": 1.0, "step": 0, "scalars": {}}'])
         assert any("required telemetry keys" in e for e in errors)
 
+    def test_transport_keys_required_only_on_request(self, checker):
+        """ISSUE 3: the socket/shm transport metrics are a separate
+        requirement tier — absent from a smoke (in-proc) run's contract,
+        enforced via extra_required for socket/shm runs — and the servers
+        eager-create every one of them, so a real transport run always
+        carries the full set."""
+        base = {k: 1.0 for k in checker.REQUIRED_KEYS}
+        # span roots spot-checked via /mean_s need the full leaf set
+        for k in list(base):
+            if k.startswith("span/"):
+                root = k.rsplit("/", 1)[0]
+                for leaf in checker.TIMER_LEAVES:
+                    base[f"{root}/{leaf}"] = 1.0
+        line = json.dumps({"ts": 1.0, "step": 0, "scalars": base})
+        assert checker.validate_lines([line]) == []
+        errors = checker.validate_lines(
+            [line], extra_required=checker.SOCKET_TRANSPORT_KEYS
+        )
+        assert any("transport/fanout_lag_max" in e for e in errors)
+        full = dict(base)
+        for k in (*checker.SOCKET_TRANSPORT_KEYS, *checker.SHM_TRANSPORT_KEYS):
+            full[k] = 0.0
+        line2 = json.dumps({"ts": 1.0, "step": 0, "scalars": full})
+        assert checker.validate_lines(
+            [line2],
+            extra_required=(
+                *checker.SOCKET_TRANSPORT_KEYS, *checker.SHM_TRANSPORT_KEYS
+            ),
+        ) == []
+
+    def test_transport_servers_emit_their_schema_keys(self):
+        """Constructing the servers alone populates every pinned transport
+        metric (eager creation — schema presence is deterministic)."""
+        import importlib.util
+
+        from dotaclient_tpu.transport import ShmTransportServer, TransportServer
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "cts", os.path.join(root, "scripts", "check_telemetry_schema.py")
+        )
+        checker = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(checker)
+        reg = telemetry.get_registry()
+        srv = TransportServer(port=0)
+        shm = ShmTransportServer(
+            name=f"tel-{os.getpid()}", slots=1, ring_bytes=1 << 14,
+            weights_bytes=1 << 14,
+        )
+        try:
+            snap = reg.snapshot()
+            for key in (
+                *checker.SOCKET_TRANSPORT_KEYS, *checker.SHM_TRANSPORT_KEYS
+            ):
+                assert key in snap, f"missing transport metric {key}"
+        finally:
+            srv.close()
+            shm.close()
+
     def test_smoke_run_passes_schema(self, checker, capsys):
         """The CI guard end-to-end: a --smoke learner run with the JSONL
         sink validates cleanly against the documented schema (tier-1

@@ -108,6 +108,100 @@ class TestSocketTransport:
         finally:
             server.close()
 
+    def test_stalled_consumer_does_not_block_fanout(self):
+        """ISSUE 3 acceptance: publish_weights is a non-blocking enqueue. A
+        consumer that never reads its socket must not delay publish_weights
+        returning, must not delay a healthy actor receiving new versions,
+        and is eventually dropped (counted) once it exceeds the lag
+        budget."""
+        import socket as socket_mod
+
+        from dotaclient_tpu.utils import telemetry
+
+        reg = telemetry.get_registry()
+        dropped_before = reg.counter("transport/fanout_conns_dropped").value
+        server = TransportServer(port=0, fanout_max_lag=4)
+        try:
+            host, port = server.address
+            stalled = socket_mod.create_connection((host, port))
+            healthy = SocketTransport(host, port)
+            deadline = time.time() + 5
+            while server.n_connected < 2 and time.time() < deadline:
+                time.sleep(0.02)
+            assert server.n_connected == 2
+            # ~4 MB payload: far beyond the socket buffers, so the stalled
+            # connection's writer blocks in its first send and stays there
+            big = {"w": np.zeros(1_000_000, np.float32)}
+            worst = 0.0
+            n_publishes = 7
+            for v in range(1, n_publishes + 1):
+                t0 = time.perf_counter()
+                server.publish_weights(encode_weights(big, v))
+                worst = max(worst, time.perf_counter() - t0)
+                time.sleep(0.05)
+            # non-blocking: each publish is serialize + per-conn enqueue; a
+            # blocking fanout would sit in sendall on the stalled socket
+            # until its TCP buffers drain (i.e. forever)
+            assert worst < 5.0, f"publish_weights blocked for {worst:.1f}s"
+            # the healthy actor still receives the latest version
+            deadline = time.time() + 20
+            got = None
+            while time.time() < deadline:
+                msg = healthy.latest_weights()
+                if msg is not None and msg.version == n_publishes:
+                    got = msg.version
+                    break
+                time.sleep(0.05)
+            assert got == n_publishes, "healthy actor starved by stalled peer"
+            # the stalled connection blew the lag budget: dropped + counted
+            deadline = time.time() + 10
+            while server.n_connected > 1 and time.time() < deadline:
+                time.sleep(0.05)
+            assert server.n_connected == 1
+            assert (
+                reg.counter("transport/fanout_conns_dropped").value
+                > dropped_before
+            )
+            stalled.close()
+            healthy.close()
+        finally:
+            server.close()
+
+    def test_weights_coalesce_to_latest(self):
+        """Back-to-back publishes while a consumer is mid-send must
+        coalesce: the actor applies the LATEST version without needing
+        every intermediate frame (IMPACT's bounded-staleness license)."""
+        from dotaclient_tpu.utils import telemetry
+
+        reg = telemetry.get_registry()
+        before = reg.counter("transport/weights_coalesced").value
+        server = TransportServer(port=0, fanout_max_lag=1_000_000)
+        try:
+            host, port = server.address
+            actor = SocketTransport(host, port)
+            deadline = time.time() + 5
+            while server.n_connected < 1 and time.time() < deadline:
+                time.sleep(0.02)
+            # 4 MB frames: the actor's reader parses slower than the
+            # learner serializes, so its TCP buffers fill and the writer
+            # reliably falls behind → coalescing must kick in
+            big = {"w": np.zeros(1_000_000, np.float32)}
+            final = 10
+            for v in range(1, final + 1):   # no pacing: force coalescing
+                server.publish_weights(encode_weights(big, v))
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                msg = actor.latest_weights()
+                if msg is not None and msg.version == final:
+                    break
+                time.sleep(0.05)
+            assert actor.latest_weights().version == final
+            assert reg.counter("transport/weights_coalesced").value > before
+            # fewer wire sends than publishes is the whole point
+            actor.close()
+        finally:
+            server.close()
+
     def test_actor_side_detects_learner_loss(self):
         server = TransportServer(port=0)
         host, port = server.address

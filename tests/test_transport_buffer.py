@@ -102,6 +102,64 @@ class TestSerialize:
         assert back.dtype == np.dtype(ml_dtypes.bfloat16)
         np.testing.assert_array_equal(back, arr)
 
+    def test_wire_dtype_bf16_halves_bytes_and_upcasts_losslessly(self):
+        """ISSUE 3: transport.wire_dtype='bfloat16' casts f32 params at
+        encode — wire bytes ≈ half — and decode upcasts to f32 values
+        exactly equal to the published bf16 values (lossless: every bf16
+        is exactly representable in f32)."""
+        import ml_dtypes
+
+        rng = np.random.default_rng(3)
+        params = {
+            "dense": {"kernel": rng.normal(size=(64, 32)).astype(np.float32),
+                      "bias": rng.normal(size=(32,)).astype(np.float32)},
+            "step": np.asarray(7, np.int64),   # non-float leaf: untouched
+        }
+        f32_wire = encode_weights(params, 9).SerializeToString()
+        m = encode_weights(params, 9, wire_dtype="bfloat16")
+        bf16_wire = m.SerializeToString()
+        # tensor payload halves; proto framing/names add a fixed overhead
+        assert len(bf16_wire) < 0.6 * len(f32_wire)
+        version, back = decode_weights(m)
+        assert version == 9
+        assert back["dense"]["kernel"].dtype == np.float32
+        assert back["step"].dtype == np.int64 and back["step"] == 7
+        expect = params["dense"]["kernel"].astype(ml_dtypes.bfloat16)
+        np.testing.assert_array_equal(
+            back["dense"]["kernel"], expect.astype(np.float32)
+        )
+        # raw wire form is inspectable: upcast=False keeps bf16
+        _, raw = decode_weights(m, upcast=False)
+        assert raw["dense"]["kernel"].dtype == np.dtype(ml_dtypes.bfloat16)
+
+    def test_wire_dtype_unknown_rejected(self):
+        with pytest.raises(ValueError, match="wire_dtype"):
+            encode_weights({"w": np.zeros(2, np.float32)}, 1,
+                           wire_dtype="float16")
+
+    def test_natively_bf16_params_never_widened(self):
+        """The upcast applies ONLY to leaves the encoder narrowed: params
+        that are bf16 in the model (param_dtype='bfloat16') keep their
+        dtype through both wire modes — decode must not guess from dtype
+        alone (review finding)."""
+        import ml_dtypes
+
+        bf16 = np.dtype(ml_dtypes.bfloat16)
+        params = {
+            "native_bf16": np.arange(6, dtype=np.float32).astype(bf16),
+            "f32": np.linspace(0, 1, 6, dtype=np.float32),
+        }
+        # float32 wire: nothing cast, nothing upcast
+        _, back = decode_weights(encode_weights(params, 1))
+        assert back["native_bf16"].dtype == bf16
+        assert back["f32"].dtype == np.float32
+        # bf16 wire: only the f32 leaf was narrowed → only it upcasts
+        _, back = decode_weights(
+            encode_weights(params, 1, wire_dtype="bfloat16")
+        )
+        assert back["native_bf16"].dtype == bf16
+        assert back["f32"].dtype == np.float32
+
 
 class TestInProcTransport:
     def test_rollout_queue_fifo_and_exactly_once(self):
@@ -322,6 +380,41 @@ class TestTrajectoryBuffer:
             buf.add([bad], current_version=0)
         assert any("shapes" in r.getMessage() for r in caplog.records)
         assert reg.snapshot()["buffer/skew_drops_total"] == 1.0
+
+    def test_ingest_scatter_trace_count_bounded(self):
+        """ADVICE round 1 retrace fix: host ingest pads each group to a
+        power-of-two bucket and scatters ONCE, so arbitrary fresh-row
+        counts compile at most log2(capacity)+1 scatter programs (and one
+        dispatch per ingest, not one per pow2 term)."""
+        buf, cfg = self.make(capacity=8, batch_rollouts=8, min_fill=8)
+        assert buf.scatter_traces == 0
+        distinct_counts = [3, 4, 2]
+        rid = 0
+        for n in distinct_counts:
+            buf.add([self.decoded(rid + k) for k in range(n)], 0)
+            rid += n
+        # 3 distinct ingest sizes → at most log2(8)+1 = 4 programs, and
+        # strictly fewer programs than distinct sizes (3 pads into 4's
+        # bucket) — the padding collapses arbitrary counts onto pow2s
+        assert buf.scatter_traces <= 4
+        assert buf.scatter_traces < len(set(distinct_counts))
+
+    def test_ingest_pad_rows_do_not_corrupt(self):
+        """Pow2 padding must be invisible: odd-count ingests followed by a
+        take return exactly the ingested rows, bit-identical, and the pad
+        never claims a slot."""
+        buf, cfg = self.make(capacity=16, batch_rollouts=8, min_fill=8)
+        rolls = [self.decoded(i) for i in range(3)]      # pads 3 → 4
+        buf.add(rolls, 0)
+        assert buf.size == 3                             # pad not booked
+        more = [self.decoded(10 + i) for i in range(5)]  # pads 5 → 8
+        buf.add(more, 0)
+        assert buf.size == 8
+        batch = buf.take(8)
+        expect = np.stack(
+            [np.asarray(r[1]["rewards"]) for r in rolls + more]
+        )
+        np.testing.assert_array_equal(np.asarray(batch["rewards"]), expect)
 
     def test_feeds_train_step(self):
         """Buffer output is a valid train batch end-to-end."""
