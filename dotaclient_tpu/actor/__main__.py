@@ -21,7 +21,9 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import os
 import random
+import signal
 import sys
 import time
 
@@ -63,8 +65,11 @@ def connect_with_backoff(
     ) from last
 
 
-def _transport_factory(args):
-    """Build the (re)connect callable for the configured transport."""
+def _transport_factory(args, transport_config):
+    """Build the (re)connect callable for the configured transport.
+    ``transport_config`` (a TransportConfig) supplies the actor-side
+    liveness/poison knobs so they stay in step with the learner's — the
+    wire carries no config handshake."""
     if args.connect and args.connect.startswith("shm://"):
         from dotaclient_tpu.transport.shm_transport import ShmTransport
 
@@ -74,7 +79,16 @@ def _transport_factory(args):
         from dotaclient_tpu.transport.socket_transport import SocketTransport
 
         host, port = args.connect.rsplit(":", 1)
-        return lambda: SocketTransport(host, int(port))
+        idle = (
+            args.idle_timeout
+            if args.idle_timeout is not None
+            else transport_config.idle_timeout_s
+        )
+        return lambda: SocketTransport(
+            host, int(port),
+            idle_timeout_s=idle,
+            poison_frame_limit=transport_config.poison_frame_limit,
+        )
     from dotaclient_tpu.transport.queues import AmqpTransport
 
     host, _, port = args.amqp.partition(":")
@@ -108,6 +122,12 @@ def main(argv=None) -> int:
     p.add_argument("--max-reconnects", type=int, default=6,
                    help="bounded connect attempts (exponential backoff + "
                         "jitter) before exiting non-zero for the supervisor")
+    p.add_argument("--idle-timeout", type=float, default=None,
+                   help="seconds of learner silence (no weights OR "
+                        "heartbeats) before declaring the connection "
+                        "half-open and reconnecting; default "
+                        "transport.idle_timeout_s — keep it above the "
+                        "learner's heartbeat_interval_s, or 0 to disable")
     args = p.parse_args(argv)
     if bool(args.connect) == bool(args.amqp):
         p.error("exactly one of --connect or --amqp is required")
@@ -115,11 +135,32 @@ def main(argv=None) -> int:
         # Replicated actor fleets must not stream identical experience: the
         # k8s manifest injects POD_NAME, and each replica hashes its unique
         # pod name into its seed — no coordination needed.
-        import os
         import zlib
 
         pod = os.environ.get("POD_NAME", "")
         args.seed = zlib.crc32(pod.encode()) & 0x7FFFFFFF if pod else 0
+
+    # Graceful stop (ISSUE 4): the first SIGTERM/SIGINT latches a stop flag
+    # — the run loop exits at its next slice boundary, flushes the partial
+    # rollouts every lane holds, and exits 0 (a drained actor is a SUCCESS
+    # to the supervisor, not a restart candidate). A second signal falls
+    # through to the default disposition and kills the process.
+    stop_flag = {"stop": False}
+
+    def _graceful(signum, frame):
+        stop_flag["stop"] = True
+        signal.signal(signum, signal.SIG_DFL)
+        print(
+            f"actor: {signal.Signals(signum).name} received — flushing "
+            f"partial rollouts and exiting (signal again to force)",
+            file=sys.stderr, flush=True,
+        )
+
+    try:
+        signal.signal(signal.SIGTERM, _graceful)
+        signal.signal(signal.SIGINT, _graceful)
+    except ValueError:
+        pass  # not the main thread (tests drive main() directly)
 
     import jax
 
@@ -130,17 +171,6 @@ def main(argv=None) -> int:
     from dotaclient_tpu.config import default_config
     from dotaclient_tpu.models import init_params, make_policy
     from dotaclient_tpu.transport import decode_weights
-
-    factory = _transport_factory(args)
-    try:
-        transport = connect_with_backoff(
-            factory, max_attempts=args.max_reconnects,
-            rng=random.Random(args.seed),
-        )
-    except (ConnectionError, OSError) as e:
-        print(f"actor: cannot reach learner ({e}); exiting for restart",
-              file=sys.stderr, flush=True)
-        return 1
 
     config = default_config()
     config = dataclasses.replace(
@@ -156,6 +186,17 @@ def main(argv=None) -> int:
                 config.ppo, rollout_len=args.rollout_len
             )
         )
+
+    factory = _transport_factory(args, config.transport)
+    try:
+        transport = connect_with_backoff(
+            factory, max_attempts=args.max_reconnects,
+            rng=random.Random(args.seed),
+        )
+    except (ConnectionError, OSError) as e:
+        print(f"actor: cannot reach learner ({e}); exiting for restart",
+              file=sys.stderr, flush=True)
+        return 1
     policy = make_policy(config.model, config.obs, config.actions)
 
     # Wait for the learner's first weights broadcast — rollouts from random
@@ -164,7 +205,7 @@ def main(argv=None) -> int:
     version = 0
     deadline = time.time() + 60.0
     params = None
-    while time.time() < deadline:
+    while time.time() < deadline and not stop_flag["stop"]:
         try:
             msg = transport.latest_weights()
         except ConnectionError as e:
@@ -213,12 +254,15 @@ def main(argv=None) -> int:
     )
     t0 = time.time()
     steps = 0
-    while not args.steps or steps < args.steps:
+    while (not args.steps or steps < args.steps) and not stop_flag["stop"]:
         try:
             pool.run(args.refresh_every, refresh_every=args.refresh_every)
-        except ConnectionError as e:
-            # transient hiccup (learner restart, broker blip): bounded
-            # backoff+jitter reconnect before giving up to the supervisor
+        except (ConnectionError, OSError) as e:
+            if stop_flag["stop"]:
+                break   # stopping anyway: drain instead of reconnecting
+            # transient hiccup (learner restart, broker blip, injected
+            # connection drop): bounded backoff+jitter reconnect before
+            # giving up to the supervisor
             print(f"actor: transport lost ({e}); reconnecting",
                   file=sys.stderr, flush=True)
             try:
@@ -231,6 +275,8 @@ def main(argv=None) -> int:
                     rng=random.Random(args.seed ^ steps),
                 )
             except (ConnectionError, OSError) as e2:
+                if stop_flag["stop"]:
+                    break   # stop requested mid-backoff: clean drain exit
                 print(
                     f"actor: reconnect failed ({e2}); exiting for restart",
                     file=sys.stderr, flush=True,
@@ -248,6 +294,24 @@ def main(argv=None) -> int:
                 f"version {pool.version}",
                 flush=True,
             )
+    if stop_flag["stop"]:
+        # drain: the partial chunk each lane holds is real experience — up
+        # to rollout_len-1 steps per lane — and the learner's buffer
+        # accepts short-``length`` chunks natively (episode boundaries ship
+        # them all the time). Best-effort: a transport that died in the
+        # same failure that stopped us must not turn a clean drain into a
+        # non-zero exit.
+        try:
+            n = pool.flush_partial()
+            print(f"actor: graceful stop — flushed {n} partial rollouts",
+                  file=sys.stderr, flush=True)
+        except (ConnectionError, OSError) as e:
+            print(f"actor: graceful stop — flush failed ({e})",
+                  file=sys.stderr, flush=True)
+    try:
+        transport.close()
+    except OSError:
+        pass
     return 0
 
 

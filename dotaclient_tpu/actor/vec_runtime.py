@@ -384,6 +384,27 @@ class VecActorPool(WindowedStatsMixin):
             self.step()
         return self.stats()
 
+    def flush_partial(self) -> int:
+        """Ship every lane's in-progress (cursor > 0) chunk NOW — the
+        graceful-stop path (ISSUE 4): a SIGTERM'd actor flushes the partial
+        rollouts it is holding instead of discarding up to
+        ``rollout_len - 1`` steps of experience per lane. Chunks go out with
+        their true ``length`` and a zero-padded tail exactly like the
+        episode-boundary partials ``_emit_chunks`` already ships, so the
+        learner's buffer needs nothing new. Returns the chunk count."""
+        lanes = np.nonzero(self._cursor > 0)[0]
+        if len(lanes) == 0:
+            return 0
+        carry_np = jax.device_get(self._carry_dev)
+        self._emit_chunks(
+            lanes,
+            np.zeros(self.n_lanes, dtype=bool),
+            self._pending_obs,
+            carry_np,
+            self.version,
+        )
+        return len(lanes)
+
     def stats(self) -> Dict[str, float]:
         recent = self.episode_rewards[-20:]
         return {

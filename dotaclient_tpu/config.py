@@ -259,6 +259,23 @@ class TransportConfig:
     shm_slots: int = 16
     shm_ring_bytes: int = 8 * 1024 * 1024
     shm_weights_bytes: int = 32 * 1024 * 1024
+    # Fault tolerance (ISSUE 4). Every wire frame carries a CRC32 trailer;
+    # a peer that ships this many CONSECUTIVE corrupt frames is quarantined
+    # (socket: connection cut; shm: slot never drained again until reaped)
+    # instead of crashing a reader thread — one bit-flipping actor must not
+    # take the learner down, and one flaky NIC must not poison the buffer.
+    poison_frame_limit: int = 8
+    # TCP-lane liveness, both directions: the learner's per-connection
+    # writer interleaves heartbeat frames with the weights fanout at this
+    # cadence (actors echo them), and either side drops/declares-dead a
+    # connection with no inbound traffic for idle_timeout_s — a half-open
+    # TCP connection (peer host died, NAT entry expired) can never wedge
+    # the fleet. 0 disables the respective check. Keep idle_timeout_s
+    # comfortably above BOTH heartbeat_interval_s and the actor's fixed
+    # ~1s echo rate limit (actors echo liveness on inbound frames at most
+    # once per second), or healthy peers get dropped as half-open.
+    heartbeat_interval_s: float = 5.0
+    idle_timeout_s: float = 30.0
 
 
 @dataclasses.dataclass(frozen=True)

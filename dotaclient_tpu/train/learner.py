@@ -67,7 +67,7 @@ from dotaclient_tpu.transport import (
     decode_rollout,
     encode_weights,
 )
-from dotaclient_tpu.utils import telemetry
+from dotaclient_tpu.utils import faults, telemetry
 from dotaclient_tpu.utils.checkpoint import CheckpointManager, shape_mismatches
 from dotaclient_tpu.utils.metrics import MetricsLogger
 
@@ -442,6 +442,13 @@ class Learner:
         # scalars costs a full sync per read, so the loop never does.
         self._host_step = int(np.asarray(self.state.step))   # host-sync-ok: one-time init
         self._host_version = int(np.asarray(self.state.version))   # host-sync-ok: one-time init
+        # Graceful-stop latch (ISSUE 4): request_stop() — typically from a
+        # SIGTERM handler — makes every train loop exit at its next step
+        # boundary, after which the normal end-of-run tail runs: the
+        # prefetch lane requeues its held batch, the full-pipeline
+        # checkpoint is taken, final weights publish, transports close.
+        self._stop_requested = False
+        self._faults = faults.get()   # None unless chaos injection is on
         # Pipeline restore (buffer contents + device-actor state) happens
         # after those components exist; weights/opt-state restored above.
         if (
@@ -452,6 +459,17 @@ class Learner:
             self._restore_pipeline()
 
     # -- loop --------------------------------------------------------------
+
+    def request_stop(self) -> None:
+        """Ask the running train() to drain and return at its next step
+        boundary (signal-handler safe: one flag write, no locks). The
+        end-of-run tail then checkpoints the FULL pipeline — a stopped run
+        resumes at the exact step with no experience loss."""
+        self._stop_requested = True
+
+    @property
+    def stop_requested(self) -> bool:
+        return self._stop_requested
 
     def ingest(self) -> int:
         with self.telemetry.span("learner/consume"):
@@ -502,6 +520,12 @@ class Learner:
         The staged loop below is the fallback for --checkify and
         ``fused_epoch=false``.
         """
+        if self._faults is not None and self._faults.fire(
+            "learner.fail_train_step"
+        ):
+            raise RuntimeError(
+                "injected fault: learner.fail_train_step (chaos harness)"
+            )
         cfg = self.config.ppo
         M = max(1, cfg.minibatches)
         E = cfg.epochs_per_batch
@@ -893,7 +917,7 @@ class Learner:
             da = self.device_actor
             k_iters = cfg.steps_per_dispatch
             frames_per = da.n_lanes * cfg.ppo.rollout_len * k_iters
-            while steps_done < num_steps:
+            while steps_done < num_steps and not self._stop_requested:
                 opp_params, opp_idx = self._league_opponent()
                 if opp_params is None:       # self-play / scripted: one
                     opp_params = self.state.params   # signature for all modes
@@ -917,7 +941,7 @@ class Learner:
             # issued behind batch N's epoch step, so the host-side take/
             # bookkeeping cost never sits between two dispatches.
             da = self.device_actor
-            while steps_done < num_steps:
+            while steps_done < num_steps and not self._stop_requested:
                 opp_params, opp_idx = self._league_opponent()
                 chunk, chunk_stats = da.collect(
                     self.state.params, opp_params=opp_params
@@ -935,7 +959,7 @@ class Learner:
                         self._prefetch_next(drain_transport=False)
                     steps_done += epochs
                     after_step(m)
-                    if steps_done >= num_steps:
+                    if steps_done >= num_steps or self._stop_requested:
                         break
         elif self.actor_mode == "external":
             # Experience arrives from standalone actor processes over the
@@ -943,7 +967,7 @@ class Learner:
             # transport drain + host-row staging + scatter + gather for
             # batch N+1 run behind batch N's dispatched step (prefetch).
             self._publish_weights()
-            while steps_done < num_steps:
+            while steps_done < num_steps and not self._stop_requested:
                 batch = self._next_batch()
                 if batch is None:
                     time.sleep(0.005)
@@ -972,7 +996,7 @@ class Learner:
             )
             actor_thread.start()
             try:
-                while steps_done < num_steps:
+                while steps_done < num_steps and not self._stop_requested:
                     if actor_error:
                         raise RuntimeError(
                             "actor thread died; learner cannot make progress"
@@ -995,7 +1019,7 @@ class Learner:
                 stop.set()
                 actor_thread.join(timeout=30.0)
         else:
-            while steps_done < num_steps:
+            while steps_done < num_steps and not self._stop_requested:
                 # Actor phase: generate experience with the current weights.
                 self.pool.set_params(self.state.params, self._host_version)
                 self._refresh_league_opponent()
@@ -1009,7 +1033,7 @@ class Learner:
                         self._prefetch_next()
                     steps_done += epochs
                     after_step(m)
-                    if steps_done >= num_steps:
+                    if steps_done >= num_steps or self._stop_requested:
                         break
         # End-of-call prefetch flush: a batch staged behind the final
         # dispatch was never trained on — return it to the ring so the
@@ -1096,6 +1120,18 @@ def main(argv=None) -> Dict[str, float]:
         "--league", type=str, default=None, metavar="K=V,...",
         help="comma-separated LeagueConfig overrides (with --opponent "
         "league), e.g. 'anchor_prob=0.25,snapshot_every=200'",
+    )
+    p.add_argument(
+        "--buffer", type=str, default=None, metavar="K=V,...",
+        help="comma-separated BufferConfig overrides, e.g. "
+        "'capacity_rollouts=64,min_fill=8'",
+    )
+    p.add_argument(
+        "--on-crash-checkpoint", action="store_true",
+        help="on an unexpected exception, attempt a best-effort weights-"
+        "only checkpoint before re-raising (needs --checkpoint-dir); the "
+        "graceful path — SIGTERM/SIGINT — always drains and saves the full "
+        "pipeline regardless of this flag",
     )
     p.add_argument(
         "--steps-per-dispatch", type=int, default=None,
@@ -1224,7 +1260,12 @@ def main(argv=None) -> Dict[str, float]:
         config = dataclasses.replace(
             config, steps_per_dispatch=args.steps_per_dispatch
         )
-    from dotaclient_tpu.config import LeagueConfig, PPOConfig, RewardConfig
+    from dotaclient_tpu.config import (
+        BufferConfig,
+        LeagueConfig,
+        PPOConfig,
+        RewardConfig,
+    )
     from dotaclient_tpu.utils.overrides import parse_dataclass_overrides
 
     if args.league and args.opponent != "league":
@@ -1234,6 +1275,7 @@ def main(argv=None) -> Dict[str, float]:
         ("--ppo", args.ppo, "ppo", PPOConfig),
         ("--reward", args.reward, "reward", RewardConfig),
         ("--league", args.league, "league", LeagueConfig),
+        ("--buffer", args.buffer, "buffer", BufferConfig),
     ):
         if not text:
             continue
@@ -1278,6 +1320,9 @@ def main(argv=None) -> Dict[str, float]:
         transport = TransportServer(
             host, int(port),
             fanout_max_lag=config.transport.fanout_max_lag,
+            poison_frame_limit=config.transport.poison_frame_limit,
+            heartbeat_interval_s=config.transport.heartbeat_interval_s,
+            idle_timeout_s=config.transport.idle_timeout_s,
         )
         print(f"learner: listening for actors on {transport.address}", flush=True)
     elif args.transport == "shm":
@@ -1288,6 +1333,7 @@ def main(argv=None) -> Dict[str, float]:
             slots=config.transport.shm_slots,
             ring_bytes=config.transport.shm_ring_bytes,
             weights_bytes=config.transport.shm_weights_bytes,
+            poison_frame_limit=config.transport.poison_frame_limit,
         )
         print(
             f"learner: shm lane {transport.address!r} "
@@ -1315,12 +1361,66 @@ def main(argv=None) -> Dict[str, float]:
     )
     from dotaclient_tpu.utils.profiling import trace
 
+    # Graceful stop (ISSUE 4): the FIRST SIGTERM/SIGINT converts to a drain
+    # — the train loop exits at its next step boundary and the end-of-run
+    # tail requeues held batches, takes the full-pipeline checkpoint, and
+    # closes transports (the finally below). A SECOND signal forces exit:
+    # the handler restores the default disposition and re-raises it, so a
+    # wedged drain can still be killed with the same signal.
+    import signal as _signal
+
+    def _graceful(signum, frame):
+        learner.request_stop()
+        name = _signal.Signals(signum).name
+        print(
+            f"learner: {name} received — draining (checkpoint + clean "
+            f"shutdown); send {name} again to force exit",
+            flush=True,
+        )
+        _signal.signal(signum, _signal.SIG_DFL)
+
+    try:
+        _signal.signal(_signal.SIGTERM, _graceful)
+        _signal.signal(_signal.SIGINT, _graceful)
+    except ValueError:
+        pass  # not the main thread (embedded use): signals stay external
+
     try:
         with trace(args.profile):
             stats = learner.train(
                 args.steps, overlap=args.overlap,
                 refresh_every=args.refresh_every,
             )
+    except BaseException as e:
+        if (
+            args.on_crash_checkpoint
+            and not isinstance(e, (KeyboardInterrupt, SystemExit))
+            and learner.ckpt is not None
+        ):
+            # Best-effort weights-only save: the state may be mid-donation
+            # or the disk may be the very thing that failed — never let the
+            # rescue attempt mask the original exception.
+            try:
+                # force=True: failures raise instead of degrading to the
+                # periodic-save counter — success must not be claimed below
+                # when the disk is the very thing that broke
+                saved = learner.ckpt.save(
+                    learner.state, learner.config, force=True
+                )
+                learner.ckpt.wait()
+                print(
+                    f"learner: crash checkpoint "
+                    f"{'saved to ' + learner.ckpt.directory if saved else 'declined (step already checkpointed)'}"
+                    f" before re-raising",
+                    flush=True,
+                )
+            except Exception as save_err:  # noqa: BLE001 - reported, not masked
+                print(
+                    f"learner: crash checkpoint failed too "
+                    f"({type(save_err).__name__}: {save_err})",
+                    flush=True,
+                )
+        raise
     finally:
         if transport is not None and hasattr(transport, "close"):
             # deterministic teardown even when train() raises: the shm

@@ -8,12 +8,20 @@ never leaves the machine. This module implements the same ``Transport``
 protocol over POSIX shared memory instead (ISSUE 3):
 
 * **rollout lane** — one single-producer/single-consumer byte ring per
-  actor slot. The producer (actor) writes ``u32 length + payload`` frames
-  and bumps a cumulative ``tail``; the consumer (learner) copies frames
-  out and bumps ``head``. No locks: SPSC with cumulative 8-byte counters
-  (written only by their owning side) needs none. A full ring drops the
-  NEW frame (counted in the ring header — the actor must never block on a
-  slow learner; cf. the socket path's drop-oldest).
+  actor slot. The producer (actor) writes ``u32 length + payload +
+  u32 crc32`` frames (the CRC trailer is ``serialize.frame_crc32`` —
+  ISSUE 4 wire integrity) and bumps a cumulative ``tail``; the consumer
+  (learner) copies frames out and bumps ``head``. No locks: SPSC with
+  cumulative 8-byte counters (written only by their owning side) needs
+  none. A full ring drops the NEW frame (counted in the ring header — the
+  actor must never block on a slow learner; cf. the socket path's
+  drop-oldest). The drain verifies each frame's CRC (the fold runs at
+  memory bandwidth — see serialize.py): a mismatch drops and counts the
+  frame (``transport/frames_corrupt_total``), an implausible length word
+  means framing is lost and the ring is resynced to its tail, and
+  ``poison_frame_limit`` consecutive bad frames quarantine the slot
+  (``transport/peers_quarantined``) — it is never drained again until its
+  claimant goes away and the slot is reaped.
 * **weights lane** — one seqlock'd slab. ``publish_weights`` bumps the
   sequence word to odd, writes version + payload, bumps it back to even;
   readers retry on a torn read (seq changed / odd). Writers never wait for
@@ -26,7 +34,9 @@ Segment layout (name = the lane's address, passed to both sides):
         [0..8)   seq   u64  (odd while the server writes)
         [8..16)  version i64
         [16..24) length  u64
-        [32..)   payload
+        [24..32) server pid beacon
+        [32..40) payload crc32 (low 4 bytes used)
+        [40..)   payload
     <name>-r<i>  i ∈ [0, slots)   rollout ring per actor slot:
         [0..8)   head  u64  cumulative bytes consumed  (learner-owned)
         [8..16)  tail  u64  cumulative bytes written   (actor-owned)
@@ -59,7 +69,8 @@ from multiprocessing import resource_tracker, shared_memory
 from typing import List, Optional, Tuple
 
 from dotaclient_tpu.protos import dota_pb2 as pb
-from dotaclient_tpu.utils import telemetry
+from dotaclient_tpu.transport.serialize import frame_crc32
+from dotaclient_tpu.utils import faults, telemetry
 
 _U64 = struct.Struct("<Q")
 _I64 = struct.Struct("<q")
@@ -77,7 +88,10 @@ _OFF_SEQ = 0
 _OFF_VERSION = 8
 _OFF_LENGTH = 16
 _OFF_SERVER_PID = 24   # liveness beacon: actors probe it (same host)
-_SLAB_HDR = 32
+_OFF_CRC = 32          # weights-payload crc32 (wire integrity, ISSUE 4)
+_SLAB_HDR = 40
+
+_FRAME_OVERHEAD = 8    # u32 length prefix + u32 crc32 trailer per ring frame
 
 # Slot-claim lockfiles live next to the segments. SharedMemory maps names
 # into /dev/shm on Linux; the lockfile's O_CREAT|O_EXCL creation is the
@@ -222,9 +236,11 @@ class ShmTransportServer:
         slots: int = 16,
         ring_bytes: int = 8 * 1024 * 1024,
         weights_bytes: int = 32 * 1024 * 1024,
+        poison_frame_limit: int = 8,
     ) -> None:
         if slots < 1:
             raise ValueError("shm transport needs at least one actor slot")
+        self._poison_frame_limit = max(1, poison_frame_limit)
         self.name = name or f"tpu-dota-{os.getpid()}"
         self.address = self.name
         self.slots = slots
@@ -291,11 +307,19 @@ class ShmTransportServer:
         self._latest_weights: Optional[pb.ModelWeights] = None
         self.bad_payloads = 0
         self._closed = False
+        # Poison-frame quarantine state (ISSUE 4): consecutive corrupt
+        # frames per slot, and the quarantine flag that stops draining a
+        # slot whose producer ships garbage (the slot returns to service
+        # when its dead claimant is reaped and a new actor claims it).
+        self._bad_streak = [0] * slots
+        self._quarantined = [False] * slots
         self._tel = telemetry.get_registry()
         # eager-create (schema stability — see socket_transport.py)
         self._tel.gauge("shm/ring_occupancy")
         self._tel.gauge("shm/ring_dropped_total")
         self._tel.gauge("transport/queue_depth")
+        self._tel.counter("transport/frames_corrupt_total")
+        self._tel.counter("transport/peers_quarantined")
 
     # -- rollout lane ------------------------------------------------------
 
@@ -307,20 +331,50 @@ class ShmTransportServer:
                 _U64.pack_into(self._rings[i].buf, _OFF_HEAD, h)
                 self._pending_head[i] = None
 
+    def _poison_slot(self, i: int) -> None:
+        """One corrupt frame from slot ``i``'s producer: count, bump the
+        streak, and quarantine the slot at ``poison_frame_limit`` — it is
+        skipped by every later drain until its claimant is reaped and a
+        fresh actor claims the ring."""
+        self._tel.counter("transport/frames_corrupt_total").inc()
+        self._bad_streak[i] += 1
+        if self._bad_streak[i] >= self._poison_frame_limit:
+            self._quarantined[i] = True
+            self._tel.counter("transport/peers_quarantined").inc()
+
+    def _resync_ring(self, i: int, mv: memoryview, tail: int) -> None:
+        """Framing lost (implausible length word): discard everything
+        buffered by fast-forwarding ``head`` to the snapshot ``tail`` —
+        the next intact frame the producer writes re-establishes framing."""
+        self._pending_head[i] = tail
+        # everything written so far counts as consumed (discarded), so the
+        # pending_rollouts gauge doesn't drift on the skipped frames
+        self._consumed[i] = _U64.unpack_from(mv, _OFF_FRAMES)[0]
+
     def _drain_ring(
         self, i: int, budget: int, out: List[memoryview]
     ) -> None:
         """Collect every complete frame from ring ``i`` (up to ``budget``
         total frames in ``out``) as ZERO-COPY memoryview slices into the
-        ring itself — per frame: one length unpack and one slice, no
-        payload copy at all (only a frame that physically wraps the ring
-        edge is copied, at most one per lap). The consumed space is not
-        released here — ``head`` advances at the next drain
+        ring itself — per frame: one length unpack, one slice, and the CRC
+        fold (serialize.frame_crc32 — memory-bandwidth speed; the ONLY
+        per-frame integrity cost, there is no fault-injection branch in
+        this loop). No payload copy at all (only a frame that physically
+        wraps the ring edge is copied, at most one per lap). The consumed
+        space is not released here — ``head`` advances at the next drain
         (``_release_pending``), after the caller has decoded/staged these
         frames; until then the producer cannot overwrite them."""
+        if self._quarantined[i]:
+            return
         mv = self._rings[i].buf
         N = self.ring_bytes
-        head = _U64.unpack_from(mv, _OFF_HEAD)[0]
+        # the consume position this CALL has already reached: the shm head
+        # word lags by one drain (deferred release), so a second pass within
+        # the same drain — the empty-result spin, or a post-resync retry —
+        # must continue from the pending position, not re-read stale frames
+        head = self._pending_head[i]
+        if head is None:
+            head = _U64.unpack_from(mv, _OFF_HEAD)[0]
         tail = _U64.unpack_from(mv, _OFF_TAIL)[0]
         if head == tail:
             return
@@ -331,14 +385,35 @@ class ShmTransportServer:
                 length = _U32.unpack_from(mv, _RING_HDR + pos)[0]
             else:
                 length = _U32.unpack(_ring_read(mv, N, pos, 4))[0]
+            if (
+                length > N - _FRAME_OVERHEAD
+                or _FRAME_OVERHEAD + length > tail - head
+            ):
+                # length word itself is garbage: framing is unrecoverable,
+                # resync to the producer's tail and count the event
+                self._poison_slot(i)
+                self._resync_ring(i, mv, tail)
+                return
             dpos = (pos + 4) % N
             if dpos + length <= N:     # common case: contiguous → view
                 base = _RING_HDR + dpos
-                out.append(mv[base:base + length])
+                payload = mv[base:base + length]
             else:                      # wraps the edge: one stitch copy
-                out.append(memoryview(_ring_read(mv, N, dpos, length)))
-            head += 4 + length
+                payload = memoryview(_ring_read(mv, N, dpos, length))
+            cpos = (dpos + length) % N
+            if cpos + 4 <= N:
+                crc = _U32.unpack_from(mv, _RING_HDR + cpos)[0]
+            else:
+                crc = _U32.unpack(_ring_read(mv, N, cpos, 4))[0]
+            head += _FRAME_OVERHEAD + length
             consumed += 1
+            if crc != frame_crc32(payload):
+                self._poison_slot(i)   # dropped + counted, not delivered
+                if self._quarantined[i]:
+                    break
+                continue
+            self._bad_streak[i] = 0
+            out.append(payload)
         if consumed:
             self._consumed[i] += consumed
             self._pending_head[i] = head
@@ -396,6 +471,15 @@ class ShmTransportServer:
                     _U64.pack_into(mv, _OFF_CLAIM, 0)
                     _unlock_slot(self.name, i)
                     self._tel.counter("shm/slots_reaped").inc()
+                    # a quarantined slot returns to service with its next
+                    # (fresh) claimant: discard the poisoned backlog and
+                    # clear the flag — the garbage producer is gone
+                    if self._quarantined[i]:
+                        self._quarantined[i] = False
+                        self._bad_streak[i] = 0
+                        self._resync_ring(
+                            i, mv, _U64.unpack_from(mv, _OFF_TAIL)[0]
+                        )
             elif not claim and os.path.exists(_lock_path(self.name, i)):
                 # claimant died in the window between creating its lockfile
                 # and publishing its pid in the claim word — the lockfile's
@@ -460,6 +544,7 @@ class ShmTransportServer:
         _U64.pack_into(mv, _OFF_SEQ, seq + 1)            # odd: write begins
         _I64.pack_into(mv, _OFF_VERSION, weights.version)
         _U64.pack_into(mv, _OFF_LENGTH, len(payload))
+        _U64.pack_into(mv, _OFF_CRC, frame_crc32(payload))
         mv[_SLAB_HDR:_SLAB_HDR + len(payload)] = payload
         _U64.pack_into(mv, _OFF_SEQ, seq + 2)            # even: stable
         self._latest_weights = weights
@@ -560,6 +645,7 @@ class ShmTransport:
         self.ring_bytes = self._ring.size - _RING_HDR
         self._mv = self._ring.buf          # cached: .buf re-wraps per access
         self._seen_version: Optional[int] = None
+        self._corrupt_version: Optional[int] = None
         self._cached: Optional[pb.ModelWeights] = None
         self._last_liveness = time.monotonic()
         self._tel = telemetry.get_registry()
@@ -572,6 +658,7 @@ class ShmTransport:
         self._tail = _U64.unpack_from(mv, _OFF_TAIL)[0]
         self._frames = _U64.unpack_from(mv, _OFF_FRAMES)[0]
         self._dropped = _U64.unpack_from(mv, _OFF_DROPPED)[0]
+        self._faults = faults.get()   # None when chaos injection is off
         self._pub_counter = self._tel.counter("transport/experience_published")
         self._drop_counter = self._tel.counter("transport/experience_dropped")
 
@@ -606,7 +693,7 @@ class ShmTransport:
         mv = self._mv
         N = self.ring_bytes
         n = len(payload)
-        need = 4 + n
+        need = _FRAME_OVERHEAD + n
         if need > N:
             raise ValueError(
                 f"rollout frame ({need} bytes) exceeds the shm ring "
@@ -619,14 +706,24 @@ class ShmTransport:
             _U64.pack_into(mv, _OFF_DROPPED, self._dropped)
             self._drop_counter.inc()
             return False
+        crc = frame_crc32(payload)
+        f = self._faults
+        if f is not None:  # chaos hooks; one None test when faults are off
+            delay = f.value("transport.delay_send")
+            if delay:
+                time.sleep(delay)
+            if f.fire("transport.corrupt_frame"):
+                crc ^= 0xDEADBEEF
         pos = tail % N
-        if pos + need <= N:        # common case: no wrap, two direct slices
+        if pos + need <= N:        # common case: no wrap, three direct writes
             base = _RING_HDR + pos
             _U32.pack_into(mv, base, n)
             mv[base + 4:base + 4 + n] = payload
+            _U32.pack_into(mv, base + 4 + n, crc)
         else:
             _ring_write(mv, N, pos, _U32.pack(n))
             _ring_write(mv, N, pos + 4, payload)
+            _ring_write(mv, N, pos + 4 + n, _U32.pack(crc))
         # tail moves only after the payload is in place: the consumer never
         # sees a half-written frame (tail and frames are adjacent — one
         # packed write publishes both)
@@ -651,10 +748,22 @@ class ShmTransport:
             version = _I64.unpack_from(mv, _OFF_VERSION)[0]
             if version == self._seen_version:
                 return self._cached  # no re-parse for an unchanged slab
+            if version == self._corrupt_version:
+                # known-corrupt slab: counted ONCE when discovered; skip
+                # the multi-MB copy + CRC fold on every poll until the
+                # server publishes a new version over it
+                return self._cached
             length = _U64.unpack_from(mv, _OFF_LENGTH)[0]
+            crc = _U64.unpack_from(mv, _OFF_CRC)[0]
             payload = bytes(mv[_SLAB_HDR:_SLAB_HDR + length])
             if _U64.unpack_from(mv, _OFF_SEQ)[0] != s1:
                 continue             # torn read: writer raced us, retry
+            if frame_crc32(payload) != crc:
+                # stable seq + bad CRC = real corruption, not a torn read:
+                # count it and keep serving the last good weights
+                self._tel.counter("transport/frames_corrupt_total").inc()
+                self._corrupt_version = version
+                return self._cached
             msg = pb.ModelWeights()
             msg.ParseFromString(payload)
             self._seen_version = version

@@ -7,8 +7,19 @@ down) over plain length-prefixed protobuf frames so the topology runs
 anywhere — including this sandbox, which has no broker — with
 ``AmqpTransport`` remaining the drop-in for clusters that do run one.
 
-Wire format per frame: 1 byte kind (0 = Rollout, 1 = ModelWeights) +
-4 bytes big-endian payload length + payload bytes.
+Wire format per frame: 1 byte kind (0 = Rollout, 1 = ModelWeights,
+2 = heartbeat) + 4 bytes big-endian payload length + 4 bytes CRC32 of
+those first 5 header bytes + payload bytes + 4 bytes big-endian CRC32
+trailer (``serialize.frame_crc32`` over the payload; heartbeats have an
+empty payload). The header carries its own CRC because the two corruption
+classes need different responses: a corrupt PAYLOAD (header intact) can be
+skipped frame-by-frame (the poison streak), but a corrupt LENGTH word
+poisons every later byte boundary — and without the header CRC a
+plausible-but-wrong length (≤ MAX_FRAME) would make the reader silently
+buffer up to that many bytes of phantom payload, swallowing good frames
+for minutes before the payload CRC even got a chance to fail. With it,
+header corruption is detected immediately and treated as fatal framing
+loss (quarantine; TCP cannot resync).
 
 * ``TransportServer`` — learner side. Owns the listening socket; every
   connected actor's rollouts funnel into one internal deque (work-queue
@@ -38,11 +49,22 @@ queue round-trip. ``consume_decoded`` then drains all ready frames in one
 lock acquisition and decodes them into zero-copy views that the trajectory
 buffer's staging lanes copy from directly.
 
-Failure model matches the reference's (SURVEY.md §5.3): actors are
-stateless and disposable — a dead connection is dropped silently server-side
-(its in-flight rollouts are lost, exactly like a RMQ consumer crash), and an
+Failure model (SURVEY.md §5.3, hardened in ISSUE 4): actors are stateless
+and disposable — a dead connection is dropped silently server-side (its
+in-flight rollouts are lost, exactly like a RMQ consumer crash), and an
 actor that loses the learner exits (after bounded reconnect attempts —
-``actor/__main__.py``) for the supervisor (k8s/systemd) to restart.
+``actor/__main__.py``) for the supervisor (k8s/systemd) to restart. On top
+of that, every frame carries a CRC32 trailer (``serialize.frame_crc32``):
+corrupt frames are dropped and counted (``transport/frames_corrupt_total``)
+and a peer that ships ``poison_frame_limit`` consecutive bad frames is
+quarantined (connection cut, ``transport/peers_quarantined``) instead of
+crashing the reader thread. Liveness runs both directions: the learner's
+per-connection writer interleaves heartbeat frames with the weights fanout,
+the actor echoes them (and times out if the learner goes silent —
+``idle_timeout_s``, parity with the shm lane's pid beacon), and the learner
+drops connections with no inbound bytes for ``idle_timeout_s``
+(``transport/conn_idle_drops``) — a half-open TCP connection can never
+wedge either side.
 """
 
 from __future__ import annotations
@@ -55,26 +77,67 @@ from collections import deque
 from typing import Deque, List, Optional, Tuple
 
 from dotaclient_tpu.protos import dota_pb2 as pb
-from dotaclient_tpu.utils import telemetry
+from dotaclient_tpu.transport.serialize import frame_crc32
+from dotaclient_tpu.utils import faults, telemetry
 
 _KIND_ROLLOUT = 0
 _KIND_WEIGHTS = 1
+_KIND_HEARTBEAT = 2
 _HEADER = struct.Struct(">BI")
+_CRC = struct.Struct(">I")
+# header-on-wire size: kind + length + CRC32 of those 5 bytes (see the
+# module docstring for why the length word carries its own CRC)
+_WIRE_HDR = _HEADER.size + _CRC.size
 MAX_FRAME = 512 * 1024 * 1024
 _RECV_CHUNK = 256 * 1024
+# echoes are rate-limited: at most one outbound liveness frame per second
+# no matter how fast weights/heartbeats arrive
+_ECHO_MIN_INTERVAL_S = 1.0
 
 
-def _send_frame(sock: socket.socket, kind: int, payload) -> None:
-    # gather write: no header+payload concat copy (payload may be a
-    # memoryview straight out of the native encoder)
-    header = _HEADER.pack(kind, len(payload))
-    sent = sock.sendmsg([header, payload])
-    if sent < len(header) + len(payload):  # rare partial send: finish it
-        if sent < len(header):
-            sock.sendall(header[sent:])
-            sent = len(header)
-        # memoryview slice — no whole-payload copy just to send the tail
-        sock.sendall(memoryview(payload)[sent - len(header):])
+def _pack_header(kind: int, length: int) -> bytes:
+    head = _HEADER.pack(kind, length)
+    return head + _CRC.pack(frame_crc32(head))
+
+
+# the full heartbeat wire frame (kind 2, empty payload, CRC of b""),
+# precomputed once: heartbeat sends and echoes are a single constant write
+_HEARTBEAT_FRAME = _pack_header(_KIND_HEARTBEAT, 0) + _CRC.pack(
+    frame_crc32(b"")
+)
+
+
+class FrameCorrupt(ValueError):
+    """A frame whose payload CRC trailer does not match (header intact —
+    the stream stays in sync, the frame alone is dropped)."""
+
+
+class FramingLost(ConnectionError):
+    """A frame whose HEADER failed its CRC: the length word cannot be
+    trusted, so every later byte boundary is garbage — the stream is
+    unusable and the connection must be torn down."""
+
+
+def _send_frame(
+    sock: socket.socket, kind: int, payload, crc: Optional[int] = None
+) -> None:
+    # gather write: no header+payload+trailer concat copy (payload may be a
+    # memoryview straight out of the native encoder). ``crc`` lets fault
+    # injection write a deliberately wrong trailer.
+    header = _pack_header(kind, len(payload))
+    trailer = _CRC.pack(frame_crc32(payload) if crc is None else crc)
+    parts = [header, payload, trailer]
+    total = len(header) + len(payload) + len(trailer)
+    sent = sock.sendmsg(parts)
+    if sent < total:  # rare partial send: finish each part's tail
+        rem = sent
+        for part in parts:
+            if rem >= len(part):
+                rem -= len(part)
+                continue
+            # memoryview slice — no whole-payload copy to send the tail
+            sock.sendall(memoryview(part)[rem:] if rem else part)
+            rem = 0
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -88,34 +151,52 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
 
 
 def _recv_frame(sock: socket.socket) -> Optional[Tuple[int, bytes]]:
-    head = _recv_exact(sock, _HEADER.size)
+    head = _recv_exact(sock, _WIRE_HDR)
     if head is None:
         return None
-    kind, length = _HEADER.unpack(head)
+    kind, length = _HEADER.unpack_from(head)
+    if _CRC.unpack_from(head, _HEADER.size)[0] != frame_crc32(
+        head[:_HEADER.size]
+    ):
+        raise FramingLost("frame header corrupt — length untrustworthy")
     if length > MAX_FRAME:
         raise ValueError(f"frame of {length} bytes exceeds MAX_FRAME")
+    # payload and trailer arrive as separate exact reads so the payload
+    # needs no trailing-slice copy (weights frames are tens of MB)
     payload = _recv_exact(sock, length)
     if payload is None:
         return None
+    trailer = _recv_exact(sock, _CRC.size)
+    if trailer is None:
+        return None
+    if _CRC.unpack(trailer)[0] != frame_crc32(payload):
+        raise FrameCorrupt(f"frame CRC mismatch ({length} byte payload)")
     return kind, payload
 
 
 class _Conn:
     """One actor connection: socket + the latest-wins weights slot its
     writer thread drains. ``sent_seq`` trails ``pending_seq`` while a send
-    is in flight; the gap is the connection's fanout lag."""
+    is in flight; the gap is the connection's fanout lag. ``last_seen``
+    (monotonic, updated by the reader on any inbound bytes) drives the
+    idle-drop check; ``bad_streak`` counts consecutive corrupt frames
+    toward the quarantine limit."""
 
     __slots__ = (
-        "sock", "cond", "pending", "pending_seq", "sent_seq", "dead",
+        "sock", "cond", "pending", "pending_crc", "pending_seq",
+        "sent_seq", "dead", "last_seen", "bad_streak",
     )
 
     def __init__(self, sock: socket.socket, seq: int) -> None:
         self.sock = sock
         self.cond = threading.Condition()
         self.pending: Optional[bytes] = None   # latest unsent weights payload
+        self.pending_crc = 0    # frame_crc32 of pending, computed ONCE
         self.pending_seq = seq
         self.sent_seq = seq      # last publish seq fully written to the wire
         self.dead = False
+        self.last_seen = time.monotonic()
+        self.bad_streak = 0
 
 
 class TransportServer:
@@ -127,11 +208,23 @@ class TransportServer:
         port: int = 0,
         max_rollouts: int = 4096,
         fanout_max_lag: int = 8,
+        poison_frame_limit: int = 8,
+        heartbeat_interval_s: float = 5.0,
+        idle_timeout_s: float = 30.0,
     ) -> None:
         self._listener = socket.create_server((host, port))
         self.address = self._listener.getsockname()
         self._max_rollouts = max_rollouts
         self._fanout_max_lag = max(1, fanout_max_lag)
+        self._poison_frame_limit = max(1, poison_frame_limit)
+        self._heartbeat_s = max(0.0, heartbeat_interval_s)
+        self._idle_timeout_s = max(0.0, idle_timeout_s)
+        # writer-loop wake granularity: fine enough to hit small heartbeat/
+        # idle windows (tests), capped at the historical 0.5 s poll
+        self._tick_s = min(
+            0.5,
+            *(v / 4 for v in (self._heartbeat_s, self._idle_timeout_s) if v),
+        ) if (self._heartbeat_s or self._idle_timeout_s) else 0.5
         self._rollouts: Deque[bytes] = deque()
         self._roll_cond = threading.Condition()
         self._conns: List[_Conn] = []
@@ -139,6 +232,7 @@ class TransportServer:
         self.bad_payloads = 0
         self._latest_weights: Optional[pb.ModelWeights] = None
         self._latest_payload: Optional[bytes] = None
+        self._latest_crc = 0
         self._publish_seq = 0
         self._weights_lock = threading.Lock()
         self._closed = threading.Event()
@@ -151,6 +245,13 @@ class TransportServer:
             "transport/weights_coalesced",
             "transport/fanout_conns_dropped",
             "transport/weights_sent",
+            # fault-tolerance layer (ISSUE 4) — pinned by
+            # check_telemetry_schema.py --require-faults
+            "transport/frames_corrupt_total",
+            "transport/peers_quarantined",
+            "transport/conn_idle_drops",
+            "transport/heartbeats_sent",
+            "transport/reader_exits",
         ):
             self._tel.counter(name)
         self._tel.gauge("transport/fanout_lag_max")
@@ -186,6 +287,7 @@ class TransportServer:
                 self._conns.append(conn)
             with self._weights_lock:
                 payload = self._latest_payload
+                payload_crc = self._latest_crc
                 seq = self._publish_seq
             with conn.cond:
                 if payload is not None and (
@@ -199,6 +301,7 @@ class TransportServer:
                     # assigned slot is still exactly as the publish left
                     # it).
                     conn.pending = payload
+                    conn.pending_crc = payload_crc
                     conn.pending_seq = seq
                     conn.sent_seq = seq - 1
                     conn.cond.notify()
@@ -211,18 +314,39 @@ class TransportServer:
                 name="transport-writer", daemon=True,
             ).start()
 
+    def _poison(self, conn: _Conn, fatal: bool = False) -> None:
+        """One corrupt frame from ``conn``: count it, advance the streak,
+        and quarantine the peer (raise, which drops the connection) once the
+        streak hits ``poison_frame_limit`` — or immediately when framing is
+        unrecoverable (``fatal``: a corrupt length word means every later
+        byte boundary is garbage, there is nothing to resync to on TCP)."""
+        self._tel.counter("transport/frames_corrupt_total").inc()
+        conn.bad_streak += 1
+        if fatal or conn.bad_streak >= self._poison_frame_limit:
+            self._tel.counter("transport/peers_quarantined").inc()
+            raise FrameCorrupt(
+                f"peer quarantined after {conn.bad_streak} consecutive "
+                f"corrupt frames"
+            )
+
     def _reader_loop(self, conn: _Conn) -> None:
         """Batched ingest: ``recv_into`` a preallocated buffer, parse every
-        complete frame per wakeup, hand the batch over under ONE lock."""
+        complete frame per wakeup, hand the batch over under ONE lock.
+        Decode/parse trouble routes through the quarantine path (counted,
+        connection dropped) — a malformed peer can never kill this thread
+        with an unhandled exception, and a reader death is itself counted
+        (``transport/reader_exits``) so a wedged fleet is diagnosable."""
         recv_buf = bytearray(_RECV_CHUNK)
         recv_view = memoryview(recv_buf)
         acc = bytearray()    # partial-frame accumulator across recvs
-        hdr = _HEADER.size
+        hdr = _WIRE_HDR
+        tail = _CRC.size
         try:
             while not self._closed.is_set():
                 n = conn.sock.recv_into(recv_view)
                 if n == 0:
                     break
+                conn.last_seen = time.monotonic()  # any inbound bytes = alive
                 acc += recv_view[:n]
                 frames: List[bytes] = []
                 off = 0
@@ -234,17 +358,31 @@ class TransportServer:
                 try:
                     while len(acc) - off >= hdr:
                         kind, length = _HEADER.unpack_from(acc, off)
-                        if length > MAX_FRAME:
-                            raise ValueError(
-                                f"frame of {length} bytes exceeds MAX_FRAME"
-                            )
-                        if len(acc) - off - hdr < length:
+                        if _CRC.unpack_from(acc, off + _HEADER.size)[
+                            0
+                        ] != frame_crc32(
+                            acc_view[off:off + _HEADER.size]
+                        ) or length > MAX_FRAME:
+                            # header (so the length word) untrustworthy:
+                            # framing lost, quarantine immediately (raises)
+                            # BEFORE buffering a phantom payload
+                            self._poison(conn, fatal=True)
+                        if len(acc) - off - hdr < length + tail:
                             break   # incomplete tail: wait for more bytes
+                        start = off + hdr
+                        off += hdr + length + tail
+                        if _CRC.unpack_from(acc, start + length)[
+                            0
+                        ] != frame_crc32(acc_view[start:start + length]):
+                            self._poison(conn)  # dropped + counted
+                            continue
+                        conn.bad_streak = 0
                         if kind == _KIND_ROLLOUT:
                             frames.append(
-                                bytes(acc_view[off + hdr:off + hdr + length])
+                                bytes(acc_view[start:start + length])
                             )
-                        off += hdr + length
+                        # weights/heartbeat kinds from an actor are liveness
+                        # traffic only (the echo path) — nothing to enqueue
                 finally:
                     acc_view.release()
                 if off:
@@ -252,8 +390,13 @@ class TransportServer:
                 if frames:
                     self._enqueue_rollouts(frames)
         except (OSError, ValueError):
-            pass  # dead actor: stateless, just drop it (SURVEY.md §5.3)
+            pass  # dead/poisoned actor: stateless, drop it (SURVEY.md §5.3)
         finally:
+            if not self._closed.is_set():
+                # counted only when the CONNECTION went away (actor death,
+                # quarantine, clean actor exit) — a learner-side close()
+                # tears every reader down and is not a peer-loss signal
+                self._tel.counter("transport/reader_exits").inc()
             self._drop(conn)
 
     def _enqueue_rollouts(self, frames: List[bytes]) -> None:
@@ -272,26 +415,68 @@ class TransportServer:
 
     def _writer_loop(self, conn: _Conn) -> None:
         """Per-connection weights writer: drain the latest-wins slot. Only
-        this thread ever writes ``conn.sock``, so no send lock exists."""
+        this thread ever writes ``conn.sock``, so no send lock exists.
+
+        Liveness duty (ISSUE 4): while the slot is empty this thread also
+        (a) interleaves heartbeat frames every ``heartbeat_interval_s`` so
+        the actor's idle timeout sees a live learner even between weight
+        publishes, and (b) drops the connection when the reader has seen no
+        inbound bytes for ``idle_timeout_s`` (``transport/conn_idle_drops``)
+        — the actor echoes heartbeats, so a healthy-but-quiet actor still
+        refreshes ``last_seen`` and only a half-open connection trips it."""
+        last_sent = time.monotonic()
         while True:
+            heartbeat = False
+            idle_drop = False
+            payload = None
             with conn.cond:
                 while (
                     conn.pending is None
                     and not conn.dead
                     and not self._closed.is_set()
                 ):
-                    conn.cond.wait(0.5)
+                    now = time.monotonic()
+                    if (
+                        self._idle_timeout_s
+                        and now - conn.last_seen > self._idle_timeout_s
+                    ):
+                        idle_drop = True
+                        break
+                    if (
+                        self._heartbeat_s
+                        and now - last_sent >= self._heartbeat_s
+                    ):
+                        heartbeat = True
+                        break
+                    conn.cond.wait(self._tick_s)
                 if conn.dead or self._closed.is_set():
                     return
-                payload, seq = conn.pending, conn.pending_seq
-                conn.pending = None
+                if conn.pending is not None:
+                    payload, seq = conn.pending, conn.pending_seq
+                    payload_crc = conn.pending_crc
+                    conn.pending = None
+            if idle_drop:
+                self._tel.counter("transport/conn_idle_drops").inc()
+                self._drop(conn)
+                return
             try:
-                _send_frame(conn.sock, _KIND_WEIGHTS, payload)
+                if payload is not None:
+                    # crc precomputed by publish_weights: one fold per
+                    # publish for the whole fleet, not one per connection
+                    _send_frame(
+                        conn.sock, _KIND_WEIGHTS, payload, crc=payload_crc
+                    )
+                elif heartbeat:
+                    conn.sock.sendall(_HEARTBEAT_FRAME)
             except (OSError, ValueError):
                 self._drop(conn)
                 return
-            conn.sent_seq = seq
-            self._tel.counter("transport/weights_sent").inc()
+            last_sent = time.monotonic()
+            if payload is not None:
+                conn.sent_seq = seq
+                self._tel.counter("transport/weights_sent").inc()
+            elif heartbeat:
+                self._tel.counter("transport/heartbeats_sent").inc()
 
     def _drop(self, conn: _Conn) -> None:
         with self._conns_lock:
@@ -333,7 +518,13 @@ class TransportServer:
                 )
                 if remaining is not None and remaining <= 0:
                     return out
-                self._roll_cond.wait(remaining)
+                # bounded wait even for timeout=None: a close (or the last
+                # reader thread dying) between the emptiness check and this
+                # wait must not park the consume loop forever on a deque
+                # nobody will ever refill
+                self._roll_cond.wait(
+                    0.5 if remaining is None else min(remaining, 0.5)
+                )
             while self._rollouts and len(out) < max_count:
                 out.append(self._rollouts.popleft())
             depth = len(self._rollouts)
@@ -379,9 +570,11 @@ class TransportServer:
         Never writes a socket — returns in O(connections) slot assignments
         regardless of how stalled any consumer is."""
         payload = weights.SerializeToString()
+        payload_crc = frame_crc32(payload)   # folded ONCE for the fleet
         with self._weights_lock:
             self._latest_weights = weights
             self._latest_payload = payload
+            self._latest_crc = payload_crc
             self._publish_seq += 1
             seq = self._publish_seq
         with self._conns_lock:
@@ -397,6 +590,7 @@ class TransportServer:
                     self._tel.counter("transport/weights_coalesced").inc()
                     pending_depth += 1
                 conn.pending = payload
+                conn.pending_crc = payload_crc
                 conn.pending_seq = seq
                 conn.cond.notify()
             lag = seq - conn.sent_seq
@@ -455,28 +649,87 @@ class TransportServer:
 
 
 class SocketTransport:
-    """Actor-side transport: connect to the learner's ``TransportServer``."""
+    """Actor-side transport: connect to the learner's ``TransportServer``.
 
-    def __init__(self, host: str, port: int, timeout: float = 30.0) -> None:
+    Liveness (ISSUE 4): the reader runs under ``idle_timeout_s`` — the
+    learner heartbeats every few seconds even when it publishes nothing, so
+    a recv that times out means the connection is half-open (learner host
+    gone, cable pulled) and the transport declares itself dead, engaging
+    the actor's reconnect/exit machinery (parity with the shm lane's pid
+    beacon). Heartbeats are echoed back so the learner's idle-drop sees a
+    live actor even between rollout publishes. Corrupt inbound frames are
+    dropped and counted; ``poison_frame_limit`` consecutive ones declare
+    the stream unusable (ConnectionError → reconnect gets a fresh one)."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        timeout: float = 30.0,
+        idle_timeout_s: float = 30.0,
+        poison_frame_limit: int = 8,
+    ) -> None:
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._sock.settimeout(None)
+        # socket-level timeout doubles as the idle detector: heartbeats
+        # arrive every heartbeat_interval_s << idle_timeout_s from a live
+        # learner, so only a half-open connection ever trips it
+        self._sock.settimeout(idle_timeout_s if idle_timeout_s > 0 else None)
+        self._poison_frame_limit = max(1, poison_frame_limit)
         self._send_lock = threading.Lock()
         self._weights_lock = threading.Lock()
         self._latest_weights: Optional[pb.ModelWeights] = None
         self._dead: Optional[BaseException] = None
+        self._faults = faults.get()
+        self._tel = telemetry.get_registry()
         self._reader = threading.Thread(
             target=self._reader_loop, name="weights-reader", daemon=True
         )
         self._reader.start()
 
     def _reader_loop(self) -> None:
+        bad_streak = 0
+        last_echo = 0.0
         try:
             while True:
-                frame = _recv_frame(self._sock)
+                try:
+                    frame = _recv_frame(self._sock)
+                except FramingLost:
+                    raise   # ConnectionError: reconnect gets a fresh stream
+                except FrameCorrupt:
+                    self._tel.counter("transport/frames_corrupt_total").inc()
+                    bad_streak += 1
+                    if bad_streak >= self._poison_frame_limit:
+                        raise ConnectionError(
+                            f"stream unusable after {bad_streak} consecutive "
+                            f"corrupt frames; reconnecting for a fresh one"
+                        )
+                    continue
+                except socket.timeout:
+                    raise ConnectionError(
+                        "learner silent past the idle timeout (no weights "
+                        "or heartbeats) — half-open connection"
+                    ) from None
                 if frame is None:
                     raise ConnectionError("learner closed the connection")
+                bad_streak = 0
+                # echo liveness on ANY inbound frame: the learner's
+                # last-seen tracking must see this actor alive even when it
+                # ships no rollouts. Heartbeats echo 1:1 (the learner paces
+                # them against its own idle budget); other frames echo
+                # rate-limited — a learner that publishes weights more
+                # often than its heartbeat interval never sends heartbeats
+                # at all, and echoing only heartbeats would get a healthy-
+                # but-quiet actor idle-dropped.
                 kind, payload = frame
+                now = time.monotonic()
+                if (
+                    kind == _KIND_HEARTBEAT
+                    or now - last_echo >= _ECHO_MIN_INTERVAL_S
+                ):
+                    last_echo = now
+                    with self._send_lock:
+                        self._sock.sendall(_HEARTBEAT_FRAME)
                 if kind != _KIND_WEIGHTS:
                     continue
                 msg = pb.ModelWeights()
@@ -498,8 +751,18 @@ class SocketTransport:
     def publish_rollout_bytes(self, payload) -> None:
         """Ship pre-serialized wire bytes-like (the native-encoder path)."""
         self._check()
+        crc = None
+        f = self._faults
+        if f is not None:  # chaos hooks; one None test when faults are off
+            delay = f.value("transport.delay_send")
+            if delay:
+                time.sleep(delay)
+            if f.fire("transport.corrupt_frame"):
+                crc = frame_crc32(payload) ^ 0xDEADBEEF
+            if f.fire("transport.drop_conn"):
+                self._sock.close()  # next send raises → reconnect machinery
         with self._send_lock:
-            _send_frame(self._sock, _KIND_ROLLOUT, payload)
+            _send_frame(self._sock, _KIND_ROLLOUT, payload, crc=crc)
 
     def consume_rollouts(
         self, max_count: int, timeout: Optional[float] = None

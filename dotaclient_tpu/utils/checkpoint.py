@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import os
 from typing import Any, Optional, Tuple
 
@@ -19,6 +20,9 @@ import orbax.checkpoint as ocp
 
 from dotaclient_tpu.config import RunConfig
 from dotaclient_tpu.train.ppo import TrainState, init_train_state
+from dotaclient_tpu.utils import faults, telemetry
+
+logger = logging.getLogger(__name__)
 
 
 def shape_mismatches(got: Any, want: Any) -> list:
@@ -57,6 +61,11 @@ class CheckpointManager:
                 max_to_keep=max_to_keep, create=True
             ),
         )
+        self._tel = telemetry.get_registry()
+        self._faults = faults.get()
+        # eager-create: a run that never fails a save still reports the 0
+        # (check_telemetry_schema.py --require-faults pins this key)
+        self._tel.counter("checkpoint/save_failures_total")
 
     def save(
         self,
@@ -69,7 +78,23 @@ class CheckpointManager:
         the rest of the system — trajectory-buffer contents/cursors and the
         actor's device state (sim, carries, PRNG) — so a restore resumes the
         EXACT pipeline, not just the weights (SURVEY.md §5.4; VERDICT round 1
-        item 9)."""
+        item 9).
+
+        Failure policy (ISSUE 4): a PERIODIC save (``force=False``) that
+        hits an I/O error — disk full, permissions yanked, a previous async
+        write surfacing its exception — degrades to a warning plus the
+        ``checkpoint/save_failures_total`` counter and returns False: losing
+        one periodic snapshot must not kill a training loop that is
+        otherwise healthy. A forced save (the end-of-run/drain snapshot) RE-
+        RAISES — silently losing the final checkpoint must stay loud."""
+        if self._faults is not None and self._faults.fire(
+            "checkpoint.fail_write"
+        ):
+            injected: Optional[BaseException] = OSError(
+                "injected fault: checkpoint.fail_write (simulated full disk)"
+            )
+        else:
+            injected = None
         step = int(state.step)
         items = dict(
             state=ocp.args.StandardSave(
@@ -86,26 +111,42 @@ class CheckpointManager:
             items["pipeline"] = ocp.args.StandardSave(
                 jax.tree.map(np.asarray, pipeline)
             )
-        # A periodic (weights-only) save and the end-of-run pipeline save
-        # land on the SAME step whenever the run length is a multiple of
-        # checkpoint_every; orbax refuses to overwrite an existing step.
-        # The pipeline save strictly supersedes the weights-only one, so
-        # replace it; without new content there is nothing to add — skip.
-        if step in self._mgr.all_steps():
-            if pipeline is None:
-                return False
-            self._mgr.wait_until_finished()
-            self._mgr.delete(step)
-            # the replacement save MUST NOT be declined: with force=False
-            # orbax's should_save rejects any step <= latest, which after
-            # the delete would mean guaranteed loss of step `step`. (A
-            # crash between delete and save durability can still lose it —
-            # replace-in-place is not atomic; the periodic saves around it
-            # bound the damage to one checkpoint interval.)
-            force = True
-        saved = self._mgr.save(
-            step, args=ocp.args.Composite(**items), force=force
-        )
+        try:
+            if injected is not None:
+                raise injected
+            # A periodic (weights-only) save and the end-of-run pipeline
+            # save land on the SAME step whenever the run length is a
+            # multiple of checkpoint_every; orbax refuses to overwrite an
+            # existing step. The pipeline save strictly supersedes the
+            # weights-only one, so replace it; without new content there is
+            # nothing to add — skip.
+            if step in self._mgr.all_steps():
+                if pipeline is None:
+                    return False
+                self._mgr.wait_until_finished()
+                self._mgr.delete(step)
+                # the replacement save MUST NOT be declined: with
+                # force=False orbax's should_save rejects any step <=
+                # latest, which after the delete would mean guaranteed loss
+                # of step `step`. (A crash between delete and save
+                # durability can still lose it — replace-in-place is not
+                # atomic; the periodic saves around it bound the damage to
+                # one checkpoint interval.)
+                force = True
+            saved = self._mgr.save(
+                step, args=ocp.args.Composite(**items), force=force
+            )
+        except (OSError, ValueError, RuntimeError) as e:
+            if force:
+                raise   # end-of-run/drain snapshot: loss must stay loud
+            self._tel.counter("checkpoint/save_failures_total").inc()
+            logger.warning(
+                "periodic checkpoint save at step %d failed (%s: %s) — "
+                "training continues; fix the storage before the next "
+                "snapshot window or the run loses restore granularity",
+                step, type(e).__name__, e,
+            )
+            return False
         return bool(saved)
 
     def restore_pipeline(self, template: Any) -> Tuple[Optional[Any], str]:

@@ -45,6 +45,14 @@ batches served) and ``learner/overlap_fraction`` (prefetch host time spent
 while a dispatch was in flight / all prefetch host time) — see
 docs/ARCHITECTURE.md "Pipelined data path".
 
+Fault-tolerance counters (ISSUE 4; docs/OPERATIONS.md "Failure modes"):
+``transport/frames_corrupt_total`` (CRC-failed wire frames dropped),
+``transport/peers_quarantined`` (poison-frame streaks cut),
+``transport/conn_idle_drops`` (half-open connections dropped),
+``transport/heartbeats_sent``, ``transport/reader_exits``,
+``checkpoint/save_failures_total`` (degraded periodic saves), and
+``faults/injected_total`` (chaos-harness injections that actually fired).
+
 Sinks: :class:`ConsoleSink` (prints only un-slashed legacy scalar keys, so
 log lines stay readable), :class:`JsonlSink` (one JSON object per emit —
 ``{"ts", "step", "scalars"}`` — for headless/bench runs), and

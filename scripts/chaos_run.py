@@ -1,0 +1,347 @@
+"""Chaos-injection supervisor: learner + N actors under a seeded fault plan.
+
+The fault-tolerance layer (ISSUE 4) only earns trust if its failure paths
+actually run, so this harness drives the REAL multi-process topology —
+standalone actor processes feeding a socket-transport learner — through a
+seeded schedule of the failures production runs see:
+
+* an actor SIGKILLed mid-run (restarted by this supervisor's restart
+  policy, like k8s would);
+* an actor whose frames are corrupted on the wire (``DOTA_FAULTS=
+  transport.corrupt_frame@F+G`` in its environment) — the learner must
+  count and drop them (``transport/frames_corrupt_total``), never crash;
+* the learner SIGTERM'd mid-run — it must drain (full-pipeline checkpoint,
+  clean exit 0) and, relaunched with ``--restore``, resume at the EXACT
+  saved optimizer step.
+
+The run PASSES when: both learner phases exit 0, no child ever dies of an
+unhandled exception (actors may exit non-zero on transport loss — that is
+the supervisor-restart contract, and this supervisor restarts them), the
+final checkpoint step equals ``saved_step + --resume-steps`` (exact-resume
+proof), and the learner observed at least one corrupt frame. A JSON
+``CHAOS_SUMMARY`` line reports the evidence. Exit status 0/1.
+
+Usage (CPU sandbox-sized defaults; ~3-6 min on a slow host):
+    python scripts/chaos_run.py --workdir /tmp/chaos --seed 0
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _latest_ckpt_step(ckpt_dir: str) -> Optional[int]:
+    """Largest integer-named subdirectory — orbax's step layout."""
+    try:
+        steps = [int(d) for d in os.listdir(ckpt_dir) if d.isdigit()]
+    except OSError:
+        return None
+    return max(steps) if steps else None
+
+
+def _jsonl_scalars(path: str) -> List[Dict]:
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass  # line mid-write when we were killed/polling
+    except OSError:
+        pass
+    return out
+
+
+class Supervisor:
+    """Launch + restart policy for one learner and N actor processes."""
+
+    def __init__(self, args) -> None:
+        self.args = args
+        self.rng = random.Random(args.seed)
+        self.port = _free_port()
+        self.workdir = args.workdir
+        self.ckpt_dir = os.path.join(self.workdir, "ckpt")
+        self.actors: List[Optional[subprocess.Popen]] = [None] * args.actors
+        self.learner: Optional[subprocess.Popen] = None
+        self.actor_restarts = 0
+        self.actor_kills = 0
+        self.shutting_down = False
+        self.deadline = time.monotonic() + args.timeout
+        os.makedirs(self.workdir, exist_ok=True)
+
+    # -- process plumbing ---------------------------------------------------
+
+    def _check_deadline(self) -> None:
+        if time.monotonic() > self.deadline:
+            raise TimeoutError(
+                f"chaos run exceeded --timeout {self.args.timeout}s"
+            )
+
+    def _spawn_learner(self, phase: int, restore: bool) -> subprocess.Popen:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"  # the harness topology is CPU-only
+        env.pop("DOTA_FAULTS", None)  # faults target specific children
+        # a pytest parent exports --xla_force_host_platform_device_count=8
+        # (tests/conftest.py); 8 virtual devices would change the learner's
+        # batch-shard divisibility rules mid-harness — children run plain
+        env.pop("XLA_FLAGS", None)
+        cmd = [
+            sys.executable, "-m", "dotaclient_tpu.train.learner",
+            "--steps", str(self.args.steps),
+            "--transport", "socket",
+            "--listen", f"127.0.0.1:{self.port}",
+            "--checkpoint-dir", self.ckpt_dir,
+            "--metrics-jsonl",
+            os.path.join(self.workdir, f"learner{phase}.jsonl"),
+            "--ppo",
+            "rollout_len=8,batch_rollouts=8,minibatches=2,"
+            "max_staleness=1000000",
+            "--buffer", "capacity_rollouts=64,min_fill=8",
+            "--refresh-every", "2",
+            "--on-crash-checkpoint",
+        ]
+        if restore:
+            cmd += ["--restore", "--steps", str(self.args.resume_steps)]
+        log = open(os.path.join(self.workdir, f"learner{phase}.log"), "w")
+        self.learner = subprocess.Popen(
+            cmd, cwd=REPO, env=env, stdout=log, stderr=subprocess.STDOUT
+        )
+        return self.learner
+
+    def _spawn_actor(self, i: int) -> subprocess.Popen:
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)  # the actor pins cpu itself
+        env.pop("XLA_FLAGS", None)      # see _spawn_learner
+        if i == 0:
+            # the designated bit-flipper: its Fth frame (and every Gth
+            # after) ships with a corrupt CRC trailer — the learner must
+            # drop + count them across BOTH phases
+            env["DOTA_FAULTS"] = (
+                f"transport.corrupt_frame@{self.args.corrupt_at}"
+                f"+{self.args.corrupt_every}"
+            )
+        else:
+            env.pop("DOTA_FAULTS", None)
+        log = open(
+            os.path.join(self.workdir, f"actor{i}.log"), "a"
+        )
+        return subprocess.Popen(
+            [
+                sys.executable, "-m", "dotaclient_tpu.actor",
+                "--connect", f"127.0.0.1:{self.port}",
+                "--n-envs", "4",
+                "--rollout-len", "8",
+                "--seed", str(i),
+                "--max-reconnects", "10",
+            ],
+            cwd=REPO, env=env, stdout=log, stderr=subprocess.STDOUT,
+        )
+
+    def _tend_actors(self) -> None:
+        """The restart policy: a dead actor (transport loss exit, our own
+        SIGKILL, ...) is relaunched — exactly what k8s would do."""
+        if self.shutting_down:
+            return
+        for i, p in enumerate(self.actors):
+            if p is None or p.poll() is not None:
+                if p is not None:
+                    self.actor_restarts += 1
+                self.actors[i] = self._spawn_actor(i)
+
+    def _stop_actors(self) -> Dict[str, int]:
+        """Graceful SIGTERM sweep (actors flush partials and exit 0), with
+        a kill escalation for stragglers."""
+        self.shutting_down = True
+        clean = 0
+        for p in self.actors:
+            if p is not None and p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + 30
+        for p in self.actors:
+            if p is None:
+                continue
+            while p.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.2)
+            if p.poll() is None:
+                p.kill()
+            elif p.returncode == 0:
+                clean += 1
+        return {"clean_actor_exits": clean}
+
+    def _wait_for_progress(
+        self, proc: subprocess.Popen, jsonl: str, min_step: int
+    ) -> int:
+        """Block until the learner's metrics stream shows step >= min_step
+        (training is really happening); returns the observed step. A
+        learner that dies BEFORE reaching it fails the run immediately —
+        that is an unhandled-exception exit, the thing this harness
+        forbids."""
+        while True:
+            self._check_deadline()
+            self._tend_actors()
+            for rec in _jsonl_scalars(jsonl):
+                if rec.get("step", -1) >= min_step:
+                    return rec["step"]
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"learner exited rc={proc.returncode} before reaching "
+                    f"step {min_step} — see its log in {self.workdir}"
+                )
+            time.sleep(0.5)
+
+    def _wait_exit(self, proc: subprocess.Popen, what: str) -> int:
+        while proc.poll() is None:
+            self._check_deadline()
+            self._tend_actors()
+            time.sleep(0.5)
+        print(f"chaos: {what} exited {proc.returncode}", flush=True)
+        return proc.returncode
+
+    # -- the scripted chaos plan -------------------------------------------
+
+    def run(self) -> Dict:
+        a = self.args
+        summary: Dict = {"seed": a.seed, "port": self.port}
+        jsonl1 = os.path.join(self.workdir, "learner1.jsonl")
+        jsonl2 = os.path.join(self.workdir, "learner2.jsonl")
+
+        learner = self._spawn_learner(1, restore=False)
+        self._tend_actors()
+
+        # 1) wait for real training progress, then SIGKILL an actor
+        # mid-stream (seeded jitter so the kill lands at a random point in
+        # its rollout/publish cycle)
+        self._wait_for_progress(learner, jsonl1, min_step=1)
+        time.sleep(self.rng.uniform(0.0, 2.0))
+        victim = self.actors[a.actors - 1]
+        if victim is not None and victim.poll() is None:
+            victim.kill()   # -9: no cleanup, the hard-failure shape
+            self.actor_kills += 1
+        summary["actor_kills"] = self.actor_kills
+
+        # 2) at the sigterm threshold, graceful-stop the learner mid-run
+        step_seen = self._wait_for_progress(
+            learner, jsonl1, min_step=a.sigterm_at
+        )
+        learner.send_signal(signal.SIGTERM)
+        rc1 = self._wait_exit(learner, "learner phase 1 (SIGTERM drain)")
+        summary["learner1_exit"] = rc1
+        saved = _latest_ckpt_step(self.ckpt_dir)
+        summary["saved_step"] = saved
+        summary["sigterm_at_step"] = step_seen
+        if rc1 != 0:
+            summary["fail"] = "learner did not drain cleanly on SIGTERM"
+            return summary
+        if not saved or saved < 1:
+            summary["fail"] = "no checkpoint captured by the drain"
+            return summary
+
+        # 3) relaunch with --restore: must resume at EXACTLY `saved` and
+        # run --resume-steps more (actors reconnect with backoff meanwhile,
+        # or exhaust retries and get restarted by the policy above)
+        learner = self._spawn_learner(2, restore=True)
+        rc2 = self._wait_exit(learner, "learner phase 2 (restored)")
+        summary["learner2_exit"] = rc2
+        summary.update(self._stop_actors())
+        final = _latest_ckpt_step(self.ckpt_dir)
+        summary["final_step"] = final
+        summary["actor_restarts"] = self.actor_restarts
+
+        # 4) verdicts
+        corrupt = 0.0
+        for rec in _jsonl_scalars(jsonl1) + _jsonl_scalars(jsonl2):
+            corrupt = max(
+                corrupt,
+                rec.get("scalars", {}).get(
+                    "transport/frames_corrupt_total", 0.0
+                ) or 0.0,
+            )
+        summary["frames_corrupt_total"] = corrupt
+        if rc2 != 0:
+            summary["fail"] = "restored learner did not complete cleanly"
+        elif final != saved + a.resume_steps:
+            summary["fail"] = (
+                f"resume was not exact: expected final step "
+                f"{saved + a.resume_steps} (= saved {saved} + "
+                f"{a.resume_steps}), got {final}"
+            )
+        elif corrupt < 1:
+            summary["fail"] = (
+                "the corrupt-frame injection was never observed by the "
+                "learner (frames_corrupt_total stayed 0)"
+            )
+        elif self.actor_kills < 1:
+            summary["fail"] = "no actor was killed — schedule never ran"
+        return summary
+
+    def cleanup(self) -> None:
+        self.shutting_down = True
+        # the learner too: a timed-out/failed plan must not orphan a live
+        # learner holding the port and writing into the workdir
+        for p in (*self.actors, self.learner):
+            if p is not None and p.poll() is None:
+                p.kill()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--workdir", default="/tmp/tpu-dota-chaos")
+    p.add_argument("--actors", type=int, default=2)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--steps", type=int, default=500,
+                   help="phase-1 step budget (never reached: the SIGTERM "
+                   "lands first, which is the point)")
+    p.add_argument("--sigterm-at", type=int, default=10,
+                   help="SIGTERM the learner once its metrics stream shows "
+                   "this optimizer step")
+    p.add_argument("--resume-steps", type=int, default=10,
+                   help="steps the restored learner must run; the final "
+                   "checkpoint must land at saved_step + this (exact "
+                   "resume)")
+    p.add_argument("--corrupt-at", type=int, default=3)
+    p.add_argument("--corrupt-every", type=int, default=5,
+                   help="actor 0 corrupts its corrupt-at'th frame and "
+                   "every corrupt-every'th after")
+    p.add_argument("--timeout", type=float, default=900.0)
+    p.add_argument("--keep-workdir", action="store_true")
+    args = p.parse_args(argv)
+
+    if os.path.isdir(args.workdir):
+        shutil.rmtree(args.workdir)
+    sup = Supervisor(args)
+    try:
+        summary = sup.run()
+    except (TimeoutError, RuntimeError) as e:
+        summary = {"fail": str(e)}
+    finally:
+        sup.cleanup()
+    summary["ok"] = "fail" not in summary
+    print("CHAOS_SUMMARY " + json.dumps(summary, sort_keys=True), flush=True)
+    if not args.keep_workdir and summary["ok"]:
+        shutil.rmtree(args.workdir, ignore_errors=True)
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -78,6 +78,20 @@ SHM_TRANSPORT_KEYS = (
     "transport/queue_depth",
 )
 
+# Fault-tolerance layer (ISSUE 4). Validated with --require-faults against
+# a run that used the socket transport AND a checkpoint dir (both eager-
+# create their counters, so presence is deterministic even for a run that
+# never saw a fault — the value is just 0). scripts/chaos_run.py's learner
+# invocations qualify.
+FAULT_KEYS = (
+    "transport/frames_corrupt_total",   # CRC-failed frames dropped
+    "transport/peers_quarantined",      # poison_frame_limit streaks cut
+    "transport/conn_idle_drops",        # half-open conns dropped (learner)
+    "transport/heartbeats_sent",        # liveness frames interleaved
+    "transport/reader_exits",           # server-side connection endings
+    "checkpoint/save_failures_total",   # degraded periodic saves
+)
+
 
 def validate_lines(
     lines: List[str], extra_required: tuple = ()
@@ -156,12 +170,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="also require the shared-memory lane metrics (for validating "
         "a --transport shm run's JSONL)",
     )
+    p.add_argument(
+        "--require-faults", action="store_true",
+        help="also require the fault-tolerance counters (for validating a "
+        "--transport socket + --checkpoint-dir run's JSONL, e.g. a "
+        "scripts/chaos_run.py learner)",
+    )
     args = p.parse_args(argv)
     extra: tuple = ()
     if args.require_transport:
         extra += SOCKET_TRANSPORT_KEYS
     if args.require_shm:
         extra += SHM_TRANSPORT_KEYS
+    if args.require_faults:
+        extra += FAULT_KEYS
 
     path = args.path
     if path is None:
