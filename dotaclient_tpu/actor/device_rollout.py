@@ -335,24 +335,46 @@ class DeviceActor:
         self._tel.counter("actor/rollouts_shipped").inc(self.n_lanes)
         return chunk, stats
 
-    def drain_stats(self) -> Dict[str, float]:
-        """Fetch the device-accumulated episode stats (4 scalars, ONE host
-        sync regardless of how many chunks were collected); call at log
-        boundaries only."""
-        with self._tel.span("actor/drain"):
-            s = jax.device_get(self.state.stats)
+    def begin_drain(self):
+        """Dispatch-only half of :meth:`drain_stats` (async snapshots,
+        ISSUE 5): copy the device stat accumulators — a tiny jitted copy,
+        so a later donating dispatch (the fused step donates the whole
+        actor state) can never invalidate the snapshot — reset them, and
+        return ``(device_stats, finish)``. ``finish(host_stats)`` runs the
+        host-side accounting and returns the scalar dict; the caller (the
+        snapshot thread, or :meth:`drain_stats` inline) feeds it the ONE
+        batched fetch of ``device_stats``."""
+        if not hasattr(self, "_stats_copy"):
+            self._stats_copy = jax.jit(
+                lambda t: jax.tree.map(jnp.copy, t)
+            )
+        dev = self._stats_copy(self.state.stats)
         self.state = self.state._replace(stats=self._zero_stats())
-        self.episodes_done += int(s["episodes"])
-        self.wins += int(s["wins"])
-        self._reward_sum += float(s["ep_return_sum"])
-        self._ep_count_window += float(s["episodes"])
-        # windowed (since previous drain) — the responsive learning signal
-        self._recent = {
-            "episodes": float(s["episodes"]),
-            "wins": float(s["wins"]),
-            "ep_return_sum": float(s["ep_return_sum"]),
-        }
-        return self.stats()
+
+        def finish(s) -> Dict[str, float]:
+            self.episodes_done += int(s["episodes"])
+            self.wins += int(s["wins"])
+            self._reward_sum += float(s["ep_return_sum"])
+            self._ep_count_window += float(s["episodes"])
+            # windowed (since previous drain) — the responsive learning signal
+            self._recent = {
+                "episodes": float(s["episodes"]),
+                "wins": float(s["wins"]),
+                "ep_return_sum": float(s["ep_return_sum"]),
+            }
+            return self.stats()
+
+        return dev, finish
+
+    def drain_stats(self) -> Dict[str, float]:
+        """Fetch the device-accumulated episode stats (a few scalars, ONE
+        host sync regardless of how many chunks were collected); call at
+        log boundaries only (async runs fetch via the snapshot thread —
+        see :meth:`begin_drain`)."""
+        dev, finish = self.begin_drain()
+        with self._tel.span("actor/drain"):
+            s = jax.device_get(dev)
+        return finish(s)
 
     def stats(self) -> Dict[str, float]:
         # mean return over COMPLETED episodes (owner-lane convention,

@@ -279,6 +279,32 @@ class TransportConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class LearnerConfig:
+    """Learner-side execution knobs (ISSUE 5).
+
+    ``async_snapshots`` routes every train-loop side effect that fetches
+    device state — the weights publish, the periodic checkpoint, and the
+    log-boundary metrics fetch — through the snapshot engine
+    (train/snapshot.py): the train thread runs one cheap jitted on-device
+    copy and dispatches the next step immediately; a background thread does
+    the device→host transfer, the wire cast + encode, the fanout enqueue,
+    and the orbax write. Published versions stay monotonic (latest-wins
+    coalescing when the thread falls behind), the graceful-stop/forced
+    checkpoint drains pending snapshots and lands at the exact stop step
+    via the sync path, and async write failures surface through the
+    ``checkpoint/save_failures_total`` degrade policy. Disable for
+    debugging (``--sync-snapshots``): every side effect runs inline on the
+    train thread, stalling it — the pre-ISSUE-5 behavior."""
+
+    async_snapshots: bool = True
+    # Upper bound on how long a graceful stop waits for the snapshot
+    # thread to finish in-flight work before proceeding with the forced
+    # sync checkpoint anyway (a wedged disk must not turn a drain into a
+    # hang; the sync save then surfaces the real error loudly).
+    snapshot_drain_timeout_s: float = 60.0
+
+
+@dataclasses.dataclass(frozen=True)
 class LeagueConfig:
     enabled: bool = False
     pool_size: int = 8
@@ -325,6 +351,7 @@ class RunConfig:
     mesh: MeshConfig = MeshConfig()
     buffer: BufferConfig = BufferConfig()
     transport: TransportConfig = TransportConfig()
+    learner: LearnerConfig = LearnerConfig()
     league: LeagueConfig = LeagueConfig()
     checkpoint_dir: str = "checkpoints"
     checkpoint_every: int = 100
@@ -368,6 +395,8 @@ class RunConfig:
             buffer=BufferConfig(**raw["buffer"]),
             # .get: absent in checkpoints written before TransportConfig
             transport=TransportConfig(**raw.get("transport", {})),
+            # .get: absent in checkpoints written before LearnerConfig
+            learner=LearnerConfig(**raw.get("learner", {})),
             league=LeagueConfig(**raw["league"]),
             # .get: absent in checkpoints written before the field existed
             checkpoint_best_min_episodes=raw.get(

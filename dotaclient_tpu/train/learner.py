@@ -21,6 +21,18 @@ only at ``log_every`` boundaries. On hardware where a host↔device round trip
 is expensive this is the difference between dispatch-rate and sync-rate
 training. ``scripts/check_host_sync.py`` guards the discipline statically.
 
+Zero-stall snapshots (ISSUE 5, docs/ARCHITECTURE.md "Zero-stall snapshots"):
+with ``learner.async_snapshots`` (the default) even the boundary-cadence
+side effects leave the train thread. At a publish/checkpoint/log boundary
+the loop runs one cheap jitted on-device copy of the needed state into
+fresh HBM snapshot buffers and dispatches the next step immediately; the
+background snapshot thread (train/snapshot.py) does the batched device→host
+fetch, the bf16 wire cast + encode, the non-blocking fanout enqueue, and
+the orbax write. Published versions stay monotonic under latest-wins
+coalescing, graceful stop drains the engine and lands the forced checkpoint
+at the EXACT stop step via the sync path, and async write failures surface
+through ``checkpoint/save_failures_total``. ``--sync-snapshots`` opts out.
+
 Pipelined data path (ISSUE 2, docs/ARCHITECTURE.md "Pipelined data path"):
 multi-epoch/minibatch batches train through the fused epoch step — ONE
 donated dispatch for all ``epochs × minibatches`` updates
@@ -329,6 +341,42 @@ class Learner:
             None if mode == "fused" else TrajectoryBuffer(config, self.mesh)
         )
         self.transport = transport or InProcTransport()
+        # Zero-stall snapshot engine (ISSUE 5, docs/ARCHITECTURE.md
+        # "Zero-stall snapshots"): weight publishes, periodic checkpoints,
+        # and log-boundary metrics fetches run on a background thread; at a
+        # boundary the train thread only runs one cheap jitted on-device
+        # copy (`_snap_copy`, dispatched BEFORE the next donating train
+        # step, so device-stream ordering protects the snapshot) and keeps
+        # dispatching. learner.async_snapshots=false (--sync-snapshots)
+        # restores the inline behavior for debugging.
+        self._snap_engine = None
+        self._snap_copy = None
+        # Deferred best-model candidate, written by the snapshot thread's
+        # metrics continuation and consumed on the train thread; the lock
+        # makes the read-and-clear swap atomic against a concurrent write
+        # (an unsynchronized swap could silently drop a qualifying peak).
+        self._pending_best: Optional[Dict[str, float]] = None
+        self._pending_best_lock = threading.Lock()
+        self._stall_s = 0.0   # train-thread seconds lost to side effects
+        if config.learner.async_snapshots:
+            from dotaclient_tpu.train.snapshot import SnapshotEngine
+
+            self._snap_engine = SnapshotEngine(
+                transport=self.transport,
+                wire_dtype=config.transport.wire_dtype,
+                ckpt=self.ckpt,
+            )
+            self._snap_copy = jax.jit(lambda t: jax.tree.map(jnp.copy, t))
+        # eager-create the stall gauges (and, sync mode, the snapshot keys
+        # the engine would have created): a clean run reports zeros —
+        # check_telemetry_schema.py --require-snapshot pins all four
+        for key in (
+            "learner/publish_stall_ms",
+            "learner/stall_fraction",
+            "snapshot/pending",
+            "snapshot/d2h_ms",
+        ):
+            telemetry.get_registry().gauge(key)
         # Vectorized mode ships decoded rollouts through an in-proc deque
         # (thread-safe append/drain) — no proto round-trip on the hot path;
         # the scalar pool keeps proto/gRPC parity coverage. Bounded with
@@ -687,19 +735,62 @@ class Learner:
                 self._mb_rng.permutation(self.config.ppo.batch_rollouts)
 
     def _publish_weights(self) -> None:
-        """Serialize current params to the transport's weights fanout (one
-        full param fetch — call at refresh cadence, not per step).
-        ``transport.wire_dtype="bfloat16"`` halves the fanout bytes (actors
-        upcast on apply); the fanout itself is non-blocking — a stalled
-        actor can never stall this call (socket_transport.py)."""
-        with self.telemetry.span("transport/publish_weights"):
-            self.transport.publish_weights(
-                encode_weights(
-                    jax.tree.map(np.asarray, self.state.params),
-                    self._host_version,
-                    wire_dtype=self.config.transport.wire_dtype,
-                )
+        """Hand the current params to the weights fanout (call at refresh
+        cadence, not per step). Async (the default): one jitted on-device
+        copy into fresh HBM snapshot buffers, then the snapshot thread does
+        the device→host fetch, the ``transport.wire_dtype`` cast + encode,
+        and the non-blocking fanout enqueue — the train thread never waits
+        on the host. Sync (``--sync-snapshots``): everything inline, with
+        ONE batched fetch inside :func:`encode_weights`. Either way the
+        fanout itself never blocks on a stalled actor
+        (socket_transport.py)."""
+        t0 = time.perf_counter()
+        if self._snap_engine is not None:
+            self._snap_engine.submit_publish(
+                self._snap_copy(self.state.params), self._host_version
             )
+        else:
+            with self.telemetry.span("transport/publish_weights"):
+                self.transport.publish_weights(
+                    encode_weights(
+                        self.state.params,   # one batched fetch inside
+                        self._host_version,
+                        wire_dtype=self.config.transport.wire_dtype,
+                    )
+                )
+        stall = time.perf_counter() - t0
+        self._stall_s += stall
+        self.telemetry.gauge("learner/publish_stall_ms").set(stall * 1e3)
+
+    def _drain_snapshots(self) -> None:
+        """Wait out the snapshot thread (graceful stop / end-of-run tail /
+        crash rescue): pending publishes reach the wire and pending async
+        saves land BEFORE the forced sync checkpoint, so the final save
+        still lands at the exact stop step with no writer overlap. Applies
+        any best-model save the async metrics path deferred to this
+        thread."""
+        if self._snap_engine is None:
+            return
+        if not self._snap_engine.drain(
+            timeout=self.config.learner.snapshot_drain_timeout_s
+        ):
+            print(
+                "WARNING: snapshot engine did not drain within "
+                f"{self.config.learner.snapshot_drain_timeout_s:.0f}s — "
+                "proceeding with the forced sync checkpoint (its error, "
+                "if any, will be the loud one)",
+                flush=True,
+            )
+        self._apply_pending_best()
+
+    def _apply_pending_best(self) -> None:
+        """Consume the best-model candidate the async metrics continuation
+        deferred to this thread (atomic swap — a concurrent write from the
+        snapshot thread must never be lost)."""
+        with self._pending_best_lock:
+            best, self._pending_best = self._pending_best, None
+        if best is not None:
+            self._maybe_save_best(best)
 
     def _league_opponent(self):
         """Snapshot-if-due and return the current frozen opponent for the
@@ -803,6 +894,40 @@ class Learner:
                 )
             os.replace(tmp, meta)
 
+    def _make_metrics_finish(
+        self,
+        step: int,
+        host_extra: Dict[str, float],
+        stats_source,
+    ):
+        """Build the host-side continuation of one async log boundary. It
+        runs ON the snapshot thread after that thread's one batched fetch
+        of the train metrics dict and must never touch ``self.state``
+        (in-flight dispatches donate its buffers) — a qualifying best-model
+        save is deferred to the train thread via ``_pending_best`` instead.
+        ``stats_source`` is a HOST-ONLY callable (the actor's ``stats()``)
+        — the actual device stat drain rides the engine's never-coalesced
+        ``submit_stats`` backlog, so a coalesced log line can never lose an
+        episode window."""
+
+        def _finish_metrics(host) -> None:
+            scalars = {k: float(v) for k, v in host["m"].items()}   # host-sync-ok: snapshot thread, fetched host arrays
+            if stats_source is not None:
+                # host-only read: every stat drain submitted up to this
+                # boundary was folded by the engine BEFORE this job ran
+                # (submit_stats ordering), so the accumulators are current
+                scalars.update(stats_source())
+            scalars.update(host_extra)
+            if self._best_dir is not None:
+                # the save itself happens on the train thread at the next
+                # boundary (or the end-of-run drain) — see _drain_snapshots
+                with self._pending_best_lock:
+                    self._pending_best = dict(scalars)
+                scalars["best_win_rate"] = self._best_win
+            self._last_metrics = self.metrics.log(step, scalars)
+
+        return _finish_metrics
+
     def _publish_pipeline_gauges(self) -> None:
         """Refresh the cross-stage gauges at a log boundary: actor weight
         staleness (host version mirror minus the actor pool's in-use
@@ -861,6 +986,15 @@ class Learner:
         t_start = time.time()
         frames_trained = 0
         steps_done = 0
+        self._stall_s = 0.0   # per-call: stall_fraction is per train() call
+        # Mid-run weights publish for the device/fused loops (ISSUE 5):
+        # they never refresh an in-process pool, so consumers on a real
+        # transport (same-host eval actors on the shm lane, socket
+        # listeners) would only ever see the end-of-run weights. In-proc
+        # transports skip it — nobody is listening.
+        publish_midrun = self.device_actor is not None and not isinstance(
+            self.transport, InProcTransport
+        )
 
         def after_step(m, frames: Optional[int] = None) -> None:
             nonlocal frames_trained
@@ -871,35 +1005,68 @@ class Learner:
             )
             step = self._host_step
             if step % cfg.log_every < stride:
-                # ONE transfer for the whole metrics dict — and the ONLY
-                # host↔device sync the train loop ever performs (spans and
-                # gauges below are host wall-clock / host ints).
-                with self.telemetry.span("learner/metrics_fetch"):
-                    scalars = {
-                        k: float(v) for k, v in jax.device_get(m).items()   # host-sync-ok: log_every boundary
-                    }
-                    if self.device_actor is not None:
-                        scalars.update(self.device_actor.drain_stats())
-                    elif self.pool is not None:
-                        scalars.update(self.pool.drain_stats())
-                # the fetch blocked on the dispatched step — overlap window
-                # for prefetch accounting closes here
-                self._dispatch_inflight = False
+                t0 = time.perf_counter()
+                # a best-model save the async metrics continuation deferred
+                # here: self.state must never be read from the snapshot
+                # thread — in-flight dispatches donate its buffers
+                self._apply_pending_best()
+                host_extra: Dict[str, float] = {}
                 if self.league is not None:
                     self._flush_league_reports()
                     wrs = self.league.win_rates()
-                    scalars["league_snapshots"] = float(len(wrs))   # host-sync-ok: host ints
+                    host_extra["league_snapshots"] = float(len(wrs))   # host-sync-ok: host ints
                     if wrs:
-                        scalars["league_winrate_mean"] = float(np.mean(wrs))   # host-sync-ok: host floats
+                        host_extra["league_winrate_mean"] = float(np.mean(wrs))   # host-sync-ok: host floats
                 if self.buffer is not None:
-                    scalars.update(self.buffer.metrics())
+                    host_extra.update(self.buffer.metrics())
                 elapsed = time.time() - t_start
-                scalars["frames_per_sec"] = frames_trained / max(elapsed, 1e-9)
-                self._maybe_save_best(scalars)
-                if self._best_dir is not None:
-                    scalars["best_win_rate"] = self._best_win
+                host_extra["frames_per_sec"] = frames_trained / max(elapsed, 1e-9)
                 self._publish_pipeline_gauges()
-                self._last_metrics = self.metrics.log(step, scalars)
+                if self._snap_engine is not None:
+                    # async (default): the device values leave through the
+                    # snapshot thread's batched fetches; this thread only
+                    # dispatches the tiny stats copy and keeps training.
+                    # The stat drain rides the never-coalesced backlog (its
+                    # accumulators were just reset — dropping it would lose
+                    # the window); the log job itself is latest-wins.
+                    stats_source = None
+                    if self.device_actor is not None:
+                        s_dev, s_fin = self.device_actor.begin_drain()
+                        self._snap_engine.submit_stats(s_dev, s_fin)
+                        stats_source = self.device_actor.stats
+                    elif self.pool is not None:
+                        # host pools: windowed stats are host floats already
+                        host_extra.update(self.pool.drain_stats())
+                    self._snap_engine.submit_metrics(
+                        {"m": m},
+                        self._make_metrics_finish(
+                            step, host_extra, stats_source
+                        ),
+                    )
+                else:
+                    # sync-snapshots mode: ONE transfer for the whole
+                    # metrics dict — the only host↔device sync this loop
+                    # performs (spans and gauges above are host values).
+                    with self.telemetry.span("learner/metrics_fetch"):
+                        scalars = {
+                            k: float(v) for k, v in jax.device_get(m).items()   # host-sync-ok: log_every boundary (sync-snapshots mode)
+                        }
+                        if self.device_actor is not None:
+                            scalars.update(self.device_actor.drain_stats())
+                        elif self.pool is not None:
+                            scalars.update(self.pool.drain_stats())
+                    # the fetch blocked on the dispatched step — overlap
+                    # window for prefetch accounting closes here
+                    self._dispatch_inflight = False
+                    scalars.update(host_extra)
+                    self._maybe_save_best(scalars)
+                    if self._best_dir is not None:
+                        scalars["best_win_rate"] = self._best_win
+                    self._last_metrics = self.metrics.log(step, scalars)
+                self._stall_s += time.perf_counter() - t0
+                self.telemetry.gauge("learner/stall_fraction").set(
+                    self._stall_s / max(elapsed, 1e-9)
+                )
             # `< stride` (not `== 0`): the counter advances in strides of
             # epochs_per_batch × steps_per_dispatch, which may step over
             # exact multiples.
@@ -908,7 +1075,22 @@ class Learner:
                 # full buffer+actor device fetch (review finding — on the
                 # tunneled link that stalls the loop for seconds); the forced
                 # end-of-run save below captures the complete pipeline
-                self.ckpt.save(self.state, cfg)
+                t0 = time.perf_counter()
+                if self._snap_engine is not None:
+                    # one cheap on-device copy of the WHOLE TrainState; the
+                    # snapshot thread fetches it (one transfer) and writes
+                    self._snap_engine.submit_checkpoint(
+                        self._snap_copy(self.state), cfg
+                    )
+                else:
+                    self.ckpt.save(self.state, cfg)
+                self._stall_s += time.perf_counter() - t0
+            if (
+                publish_midrun
+                and refresh_every
+                and step % (refresh_every * stride) < stride
+            ):
+                self._publish_weights()
 
         if self.fused_step is not None:
             # Fused mode: rollout + update is ONE program; each dispatch
@@ -1041,6 +1223,10 @@ class Learner:
         if self.buffer is not None:
             self._flush_prefetch()
         self._dispatch_inflight = False
+        # Async boundary jobs still in flight must land before the tail
+        # reads/mutates the shared stats below (and any deferred best-model
+        # save applies); the snapshot thread is idle afterwards.
+        self._drain_snapshots()
         if self.device_actor is not None:
             # End-of-call drain: the windowed stats cover this train() call
             # (the demo's block cadence) — the second best-model hook, so
@@ -1050,9 +1236,14 @@ class Learner:
             self._maybe_save_best(self.pool.drain_stats())
         if self.league is not None:
             self._flush_league_reports()
-        # Publish final weights for out-of-process actors (cluster parity).
+        # Publish final weights for out-of-process actors (cluster parity);
+        # drain so they reach the wire before the caller closes transports.
         self._publish_weights()
+        self._drain_snapshots()
         if self.ckpt:
+            # The forced end-of-run/drain save stays SYNC (the snapshot
+            # thread is drained and idle): it lands at the EXACT stop step
+            # and an I/O failure here raises loudly (ISSUE 4 policy).
             self.ckpt.save(
                 self.state, cfg, force=True,
                 pipeline=self._pipeline_state(),
@@ -1125,6 +1316,13 @@ def main(argv=None) -> Dict[str, float]:
         "--buffer", type=str, default=None, metavar="K=V,...",
         help="comma-separated BufferConfig overrides, e.g. "
         "'capacity_rollouts=64,min_fill=8'",
+    )
+    p.add_argument(
+        "--sync-snapshots", action="store_true",
+        help="debug opt-out of the async snapshot engine (ISSUE 5): run "
+        "the weights publish, periodic checkpoints, and log-boundary "
+        "metrics fetch inline on the train thread (stalling it) instead "
+        "of on the background snapshot thread",
     )
     p.add_argument(
         "--on-crash-checkpoint", action="store_true",
@@ -1311,6 +1509,12 @@ def main(argv=None) -> Dict[str, float]:
                 config.transport, wire_dtype=args.wire_dtype
             )
         )
+    if args.sync_snapshots:
+        config = dataclasses.replace(
+            config, learner=dataclasses.replace(
+                config.learner, async_snapshots=False
+            )
+        )
 
     transport = None
     if args.transport == "socket":
@@ -1399,7 +1603,13 @@ def main(argv=None) -> Dict[str, float]:
         ):
             # Best-effort weights-only save: the state may be mid-donation
             # or the disk may be the very thing that failed — never let the
-            # rescue attempt mask the original exception.
+            # rescue attempt mask the original exception. The crash save is
+            # SYNC by contract (ISSUE 5): drain the snapshot thread first so
+            # a pending async write can't race the rescue write.
+            try:
+                learner._drain_snapshots()
+            except Exception:  # noqa: BLE001 - rescue path, keep going
+                pass
             try:
                 # force=True: failures raise instead of degrading to the
                 # periodic-save counter — success must not be claimed below

@@ -91,7 +91,15 @@ def flatten_tree(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
     elif isinstance(tree, (tuple, list)):
         items = ((str(i), v) for i, v in enumerate(tree))
     else:
-        out[prefix.rstrip("/")] = np.asarray(tree)
+        # array-likes (numpy AND device arrays) pass through untouched:
+        # np.asarray on a device array is a per-leaf host↔device sync —
+        # encode_weights batches its fetch over the whole tree instead
+        # (ISSUE 5); plain scalars/lists still materialize here
+        out[prefix.rstrip("/")] = (
+            tree
+            if hasattr(tree, "dtype") and hasattr(tree, "shape")
+            else np.asarray(tree)
+        )
         return out
     for k, v in items:
         out.update(flatten_tree(v, f"{prefix}{k}/"))
@@ -394,6 +402,12 @@ def encode_weights(
     decode side upcasts exactly those leaves on apply (recorded in an
     in-band marker entry). Non-f32 leaves (int counters, natively-bf16
     params) pass through unchanged in both directions.
+
+    Device-resident params are fetched with ONE batched ``jax.device_get``
+    over the whole tree — one host↔device sync per publish instead of one
+    per leaf (ISSUE 5); host arrays pass through untouched. The async
+    snapshot engine already hands this function host arrays, so its calls
+    never sync at all.
     """
     if wire_dtype not in ("float32", "bfloat16"):
         raise ValueError(f"unknown wire_dtype {wire_dtype!r}")
@@ -404,7 +418,12 @@ def encode_weights(
         cast = _BFLOAT16
     msg = pb.ModelWeights(version=version)
     cast_names = []
-    for name, arr in flatten_tree(params).items():
+    flat = flatten_tree(params)
+    if any(not isinstance(a, np.ndarray) for a in flat.values()):
+        import jax  # deferred: the codec itself stays importable jax-free
+
+        flat = jax.device_get(flat)  # host-sync-ok: ONE batched fetch per publish
+    for name, arr in flat.items():
         a = np.asarray(arr)
         if cast is not None and a.dtype == np.float32:
             a = a.astype(cast)

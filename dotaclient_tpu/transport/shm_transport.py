@@ -532,6 +532,12 @@ class ShmTransportServer:
     # -- weights lane ------------------------------------------------------
 
     def publish_weights(self, weights: pb.ModelWeights) -> None:
+        """Seqlock'd slab write (single writer by contract). With the
+        learner's async snapshot engine (ISSUE 5) that writer is the
+        SNAPSHOT thread; in --sync-snapshots mode it is the train thread —
+        never both (the engine serializes all publishes, and the tail
+        drains before any mode change). Must stay free of host↔device
+        syncs (scripts/check_host_sync.py scans this function)."""
         payload = weights.SerializeToString()
         mv = self._weights.buf
         cap = self._weights.size - _SLAB_HDR

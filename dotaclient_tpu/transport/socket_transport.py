@@ -568,7 +568,16 @@ class TransportServer:
         """Non-blocking fanout: serialize once, assign the shared payload to
         every connection's latest-wins slot, drop over-budget connections.
         Never writes a socket — returns in O(connections) slot assignments
-        regardless of how stalled any consumer is."""
+        regardless of how stalled any consumer is.
+
+        Caller threading (ISSUE 5): with the learner's async snapshot
+        engine this runs on the SNAPSHOT thread (the train thread only
+        dispatches an on-device copy); in --sync-snapshots mode it runs on
+        the train thread. Either way there is exactly one publisher — the
+        locks here protect against the reader/accept threads, not against
+        concurrent publishers. Must stay free of host↔device syncs (the
+        engine hands it host arrays already; scripts/check_host_sync.py
+        scans this function)."""
         payload = weights.SerializeToString()
         payload_crc = frame_crc32(payload)   # folded ONCE for the fleet
         with self._weights_lock:
@@ -603,9 +612,9 @@ class TransportServer:
             self._drop(conn)
         self._tel.counter("transport/weights_published").inc()
         self._tel.gauge("transport/weights_version").set(weights.version)
-        self._tel.gauge("transport/fanout_lag_max").set(float(max_lag))
+        self._tel.gauge("transport/fanout_lag_max").set(float(max_lag))   # host-sync-ok: host ints
         self._tel.gauge("transport/fanout_queue_depth").set(
-            float(pending_depth)
+            float(pending_depth)   # host-sync-ok: host ints
         )
         self._tel.gauge("transport/actors_connected").set(self.n_connected)
 

@@ -45,6 +45,19 @@ batches served) and ``learner/overlap_fraction`` (prefetch host time spent
 while a dispatch was in flight / all prefetch host time) — see
 docs/ARCHITECTURE.md "Pipelined data path".
 
+Zero-stall snapshot engine (ISSUE 5; docs/ARCHITECTURE.md "Zero-stall
+snapshots"): ``snapshot/pending`` (engine job slots occupied),
+``snapshot/d2h_ms`` (last batched device→host fetch on the snapshot
+thread), ``snapshot/<kind>_coalesced`` for kind ∈ publish/checkpoint/
+metrics (latest-wins replacements when the thread falls behind),
+``snapshot/errors_total`` (jobs that failed without killing the engine),
+``learner/publish_stall_ms`` (train-thread time lost to the last publish —
+the on-device copy dispatch in async mode, the full fetch+encode+enqueue in
+sync mode), and ``learner/stall_fraction`` (cumulative side-effect stall /
+train() wall time). The engine records ``span/transport/publish_weights``
+and ``span/learner/metrics_fetch`` from its own thread, keeping those keys
+stable across modes.
+
 Fault-tolerance counters (ISSUE 4; docs/OPERATIONS.md "Failure modes"):
 ``transport/frames_corrupt_total`` (CRC-failed wire frames dropped),
 ``transport/peers_quarantined`` (poison-frame streaks cut),

@@ -78,6 +78,18 @@ SHM_TRANSPORT_KEYS = (
     "transport/queue_depth",
 )
 
+# Zero-stall snapshot engine (ISSUE 5). The learner eager-creates every one
+# of these at construction — in BOTH async and sync-snapshots modes — so a
+# clean run deterministically reports zeros. Validated with
+# --require-snapshot against any learner run's JSONL (the keys are
+# unconditional, unlike the transport tiers).
+SNAPSHOT_KEYS = (
+    "snapshot/pending",             # job slots occupied (engine backlog)
+    "snapshot/d2h_ms",              # last batched device→host fetch
+    "learner/publish_stall_ms",     # train-thread time lost per publish
+    "learner/stall_fraction",       # side-effect stall / train() wall time
+)
+
 # Fault-tolerance layer (ISSUE 4). Validated with --require-faults against
 # a run that used the socket transport AND a checkpoint dir (both eager-
 # create their counters, so presence is deterministic even for a run that
@@ -176,6 +188,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--transport socket + --checkpoint-dir run's JSONL, e.g. a "
         "scripts/chaos_run.py learner)",
     )
+    p.add_argument(
+        "--require-snapshot", action="store_true",
+        help="also require the zero-stall snapshot-engine keys (ISSUE 5); "
+        "valid against ANY learner run's JSONL — the learner eager-creates "
+        "them in async and sync-snapshots modes alike",
+    )
     args = p.parse_args(argv)
     extra: tuple = ()
     if args.require_transport:
@@ -184,6 +202,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         extra += SHM_TRANSPORT_KEYS
     if args.require_faults:
         extra += FAULT_KEYS
+    if args.require_snapshot:
+        extra += SNAPSHOT_KEYS
 
     path = args.path
     if path is None:
