@@ -302,6 +302,58 @@ def bench_stall(config) -> dict:
     return out
 
 
+def bench_health(config) -> dict:
+    """Health stage (ISSUE 6): fused-path step throughput with the
+    training-health probe ON vs OFF.
+
+    The probe is two scalar ops inside the compiled program plus a
+    host-side verdict submit per dispatch (the monitor's deque append; the
+    batched fetch rides the snapshot thread). The acceptance budget is
+    ``health_overhead`` ≤ 2% of fused throughput — measured on the fused
+    path because it is the repo's raw-speed ceiling (one dispatch per
+    iteration: nowhere for probe cost to hide). Best-of-2 segments per
+    variant, interleaved-by-order, same best-of rule as every other stage
+    on this noise-prone host."""
+    import dataclasses
+
+    from dotaclient_tpu.config import HealthConfig
+    from dotaclient_tpu.train.learner import Learner
+
+    base = dataclasses.replace(
+        config,
+        env=dataclasses.replace(
+            config.env, n_envs=128, opponent="scripted_easy",
+            max_dota_time=120.0,
+        ),
+        log_every=10**9,   # no boundaries: the probe itself is the subject
+    )
+    steps = 100
+    out: dict = {}
+    for label, enabled in (("off", False), ("on", True)):
+        cfg = dataclasses.replace(
+            base, health=HealthConfig(enabled=enabled)
+        )
+        learner = Learner(cfg, actor="fused")
+        try:
+            learner.train(10)   # compile + settle
+            best = 0.0
+            for _ in range(2):
+                t0 = time.perf_counter()
+                learner.train(steps)
+                best = max(best, steps / (time.perf_counter() - t0))
+            out[f"{label}_steps_per_sec"] = round(best, 2)
+        finally:
+            if learner._snap_engine is not None:
+                learner._snap_engine.stop()
+    off, on = out["off_steps_per_sec"], out["on_steps_per_sec"]
+    # capability ratio: >0 means the probe cost throughput; tiny negative
+    # values are host noise (clamped to 0 so the headline reads sanely)
+    out["health_overhead"] = (
+        round(max(0.0, 1.0 - on / off), 4) if off else 1.0
+    )
+    return out
+
+
 def main() -> None:
     from dotaclient_tpu.config import default_config
     from dotaclient_tpu.models import init_params, make_policy
@@ -475,6 +527,14 @@ def main() -> None:
     except Exception as e:
         stall = {"error": f"{type(e).__name__}: {e}"}
 
+    # -- health stage: fused throughput, probe on vs off (ISSUE 6) -----------
+    try:
+        health = bench_health(config)
+        # acceptance: health_overhead ≤ 0.02 (probe costs ≤2% throughput)
+        stages["health_overhead"] = health.get("health_overhead", 1.0)
+    except Exception as e:
+        health = {"error": f"{type(e).__name__}: {e}"}
+
     anchor = None
     if os.path.exists(ANCHOR_PATH):
         try:
@@ -508,6 +568,7 @@ def main() -> None:
                 "stages": stages,
                 "transport": transport,
                 "stall": stall,
+                "health": health,
                 "telemetry_jsonl": telemetry_path,
             }
         )

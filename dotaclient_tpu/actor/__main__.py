@@ -35,6 +35,7 @@ def connect_with_backoff(
     max_delay: float = 10.0,
     sleep=time.sleep,
     rng: "random.Random | None" = None,
+    should_abort=None,
 ):
     """Call ``factory()`` until it returns a transport, with exponential
     backoff + full jitter between attempts (bounded — a learner that is
@@ -42,7 +43,13 @@ def connect_with_backoff(
 
     Every retry (attempt beyond the first) bumps the
     ``transport/reconnects_total`` counter; the final failure re-raises the
-    last connection error.
+    last connection error. ``should_abort`` (when given) is polled between
+    backoff segments: a graceful stop requested mid-reconnect abandons the
+    remaining schedule within one sleep segment instead of riding out the
+    full backoff — at chaos-scale reconnect budgets the tail of the
+    schedule can outlive the supervisor's SIGTERM→SIGKILL grace window,
+    turning a clean drain (and its ACTOR_VERSIONS_SEEN audit line) into a
+    silent kill.
     """
     from dotaclient_tpu.utils import telemetry
 
@@ -56,6 +63,10 @@ def connect_with_backoff(
             # learner must not be met by a synchronized thundering herd
             delay = min(max_delay, base_delay * (2 ** (attempt - 1)))
             sleep(rng.uniform(0.0, delay))
+        if should_abort is not None and should_abort():
+            raise ConnectionError(
+                "reconnect abandoned: stop requested"
+            ) from last
         try:
             return factory()
         except (ConnectionError, OSError) as e:
@@ -192,6 +203,7 @@ def main(argv=None) -> int:
         transport = connect_with_backoff(
             factory, max_attempts=args.max_reconnects,
             rng=random.Random(args.seed),
+            should_abort=lambda: stop_flag["stop"],
         )
     except (ConnectionError, OSError) as e:
         print(f"actor: cannot reach learner ({e}); exiting for restart",
@@ -273,6 +285,7 @@ def main(argv=None) -> int:
                 transport = connect_with_backoff(
                     factory, max_attempts=args.max_reconnects,
                     rng=random.Random(args.seed ^ steps),
+                    should_abort=lambda: stop_flag["stop"],
                 )
             except (ConnectionError, OSError) as e2:
                 if stop_flag["stop"]:
@@ -308,6 +321,16 @@ def main(argv=None) -> int:
         except (ConnectionError, OSError) as e:
             print(f"actor: graceful stop — flush failed ({e})",
                   file=sys.stderr, flush=True)
+    # Machine-readable record of every weight version this actor APPLIED —
+    # the chaos divergence scenario's evidence that no health-blocked
+    # (poisoned) version ever reached the fleet (scripts/chaos_run.py).
+    import json as _json
+
+    print(
+        "ACTOR_VERSIONS_SEEN "
+        + _json.dumps(sorted(pool.versions_applied)),
+        flush=True,
+    )
     try:
         transport.close()
     except OSError:

@@ -154,6 +154,21 @@ class DeviceActor:
         self._ep_count_window = 0.0
         self._tel = registry if registry is not None else telemetry.get_registry()
 
+    def reset_recurrent(self) -> None:
+        """Zero every lane's recurrent carry (learner + opponent sides).
+
+        Divergence-rollback hygiene (ISSUE 6): carries were computed by
+        the poisoned params and would re-poison the restored policy's
+        first forward; the sim worlds themselves stay finite (sampled
+        actions are always in-range ints) and keep their episodes."""
+        opp_lanes = max(
+            len(self.opponent_players) * self.spec.n_games, 1
+        )
+        self.state = self.state._replace(
+            carry=self.policy.initial_state(self.n_lanes),
+            opp_carry=self.policy.initial_state(opp_lanes),
+        )
+
     @staticmethod
     def _zero_stats() -> Dict[str, jnp.ndarray]:
         z = jnp.zeros((), jnp.float32)

@@ -26,7 +26,7 @@ import numpy as np
 
 from dotaclient_tpu.actor.window_stats import WindowedStatsMixin
 from dotaclient_tpu.config import RunConfig
-from dotaclient_tpu.utils import telemetry
+from dotaclient_tpu.utils import faults, telemetry
 from dotaclient_tpu.envs.vec_lane_sim import (
     OPPONENT_CONTROL,
     VecLaneSim,
@@ -168,6 +168,12 @@ class VecActorPool(WindowedStatsMixin):
         self.episode_rewards: List[float] = []
         self.wins = 0
         self._tel = telemetry.get_registry()
+        self._faults = faults.get()   # None unless chaos injection is on
+        # Every distinct weight version this pool has ever APPLIED — the
+        # chaos harness's evidence that no poisoned (health-blocked)
+        # version reached an actor (scripts/chaos_run.py divergence
+        # scenario; bounded by the number of publishes).
+        self.versions_applied = {version}
 
     # -- weights -----------------------------------------------------------
 
@@ -184,6 +190,7 @@ class VecActorPool(WindowedStatsMixin):
         # behind at the moment it caught up (IMPACT-style staleness)
         self._tel.gauge("actor/weight_refresh_lag").set(version - self.version)
         self._weights = (params, version)
+        self.versions_applied.add(version)
 
     def set_opponent(self, params: Any, version: int) -> None:
         """Give the opponent lanes (league mode) their frozen params."""
@@ -202,6 +209,7 @@ class VecActorPool(WindowedStatsMixin):
         )
         version, tree = decode_weights(msg)
         self._weights = (jax.tree.map(jnp.asarray, tree), version)
+        self.versions_applied.add(version)
         return True
 
     # -- stepping ----------------------------------------------------------
@@ -331,6 +339,13 @@ class VecActorPool(WindowedStatsMixin):
                 "total_reward": float(self._rew_buf[l, :n].sum()),
             }
             self._next_rollout_id += 1
+            if self._faults is not None and self._faults.fire(
+                "actor.nonfinite_payload"
+            ):
+                # semantic-integrity chaos (ISSUE 6): a NaN reward ships in
+                # an otherwise well-formed frame — the CRC layer passes it,
+                # the learner buffer's admission control must reject it
+                arrays["rewards"][0] = np.nan
             out.append((meta, arrays))
             # next chunk state
             self._cursor[l] = 0

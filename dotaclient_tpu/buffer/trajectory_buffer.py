@@ -80,9 +80,23 @@ class TrajectoryBuffer:
         # optimizer step — epochs_per_batch × minibatches ticks per batch.
         # Scale the threshold so max_staleness keeps meaning "batches
         # behind" regardless of the multi-epoch/minibatch configuration.
+        # buffer.max_weight_staleness >= 0 overrides with a RAW version
+        # delta — the admission-control knob (ISSUE 6) fleets bound
+        # staleness with directly.
         self._staleness_limit = (
-            config.ppo.max_staleness * config.ppo.steps_per_batch
+            config.buffer.max_weight_staleness
+            if config.buffer.max_weight_staleness >= 0
+            else config.ppo.max_staleness * config.ppo.steps_per_batch
         )
+        # Admission control (ISSUE 6): semantic integrity at the buffer
+        # door. Counters are eager-created — a clean run reports zeros
+        # (check_telemetry_schema.py --require-health pins
+        # buffer/stale_rejected_total).
+        self._reject_nonfinite = config.buffer.reject_nonfinite
+        self.dropped_nonfinite = 0
+        self._tel.counter("buffer/stale_rejected_total")
+        self._tel.counter("buffer/nonfinite_rejected_total")
+        self._tel.counter("buffer/poison_dropped_total")
         self._sharding = data_sharding(mesh, config.mesh)
         template = example_batch(config, batch=cap)
         self._store = jax.tree.map(
@@ -186,6 +200,7 @@ class TrajectoryBuffer:
         for meta, arrays in rollouts:
             if current_version - meta["model_version"] > self._staleness_limit:
                 self.dropped_stale += 1
+                self._tel.counter("buffer/stale_rejected_total").inc()
                 continue
             if not self._matches_slot(arrays):
                 self.dropped_skew += 1
@@ -201,6 +216,16 @@ class TrajectoryBuffer:
                         "different rollout_len/obs/model config?) — align "
                         "actor and learner configs"
                     )
+                continue
+            if self._reject_nonfinite and not self._payload_finite(arrays):
+                # Semantic admission control (ISSUE 6): a NaN/Inf anywhere
+                # in a payload's float leaves (observations, rewards,
+                # behavior logp, carries) would flow straight into the loss
+                # and poison the params — reject at the door, like the wire
+                # layer rejects CRC failures. Counted, never fatal: actors
+                # are disposable, the learner is not.
+                self.dropped_nonfinite += 1
+                self._tel.counter("buffer/nonfinite_rejected_total").inc()
                 continue
             fresh.append((meta, arrays))
         if len(fresh) > self.capacity:
@@ -244,6 +269,16 @@ class TrajectoryBuffer:
             self.ingested += n
         self._publish_telemetry()
         return len(fresh)
+
+    def _payload_finite(self, arrays: Any) -> bool:
+        """True iff every float leaf of a host payload is finite. One
+        vectorized pass per leaf — the staging copy touches the same bytes
+        anyway, so the scan rides the ingest's existing memory traffic."""
+        for leaf in jax.tree.leaves(arrays):
+            a = np.asarray(leaf)
+            if a.dtype.kind == "f" and not np.isfinite(a).all():
+                return False
+        return True
 
     def _matches_slot(self, arrays: Any) -> bool:
         """True iff ``arrays`` has exactly the slot pytree/shape/dtype."""
@@ -395,6 +430,9 @@ class TrajectoryBuffer:
                 )
                 self._free.extend(stale)
                 self.dropped_stale += len(stale)
+                self._tel.counter("buffer/stale_rejected_total").inc(
+                    len(stale)
+                )
         if not self._warmed:
             if not self.ready:
                 return None
@@ -434,6 +472,23 @@ class TrajectoryBuffer:
         relative order — the next ``take`` re-gathers the same rows."""
         self._order.extendleft(reversed(self._held.pop(ticket, ())))
 
+    def drop_newer_than(self, version: int) -> int:
+        """Divergence-rollback hygiene (ISSUE 6): drop every unconsumed
+        slot whose producer version is NEWER than ``version`` — experience
+        generated by the poisoned policy of the abandoned timeline must
+        not train the restored state. Counted in
+        ``buffer/poison_dropped_total``; held (prefetch) slots must be
+        requeued by the caller first (the learner's rollback flushes its
+        prefetch lane before calling this)."""
+        bad = [s for s in self._order if self._slot_version[s] > version]
+        if bad:
+            bad_set = set(bad)
+            self._order = deque(s for s in self._order if s not in bad_set)
+            self._free.extend(bad)
+            self._tel.counter("buffer/poison_dropped_total").inc(len(bad))
+            self._publish_telemetry()
+        return len(bad)
+
     def requeue_all_held(self) -> None:
         """Defensive checkpoint hook: park nothing across a state_dict —
         newest tickets first, so the oldest held batch ends up at the very
@@ -465,7 +520,7 @@ class TrajectoryBuffer:
                 [
                     int(self._warmed), self.dropped_stale,
                     self.dropped_overflow, self.ingested,
-                    self.dropped_skew,
+                    self.dropped_skew, self.dropped_nonfinite,
                 ],
                 np.int64,
             ),
@@ -483,15 +538,16 @@ class TrajectoryBuffer:
         self._held = {}   # snapshots never carry in-flight holds
         self._slot_version = np.asarray(state["slot_version"]).copy()
         counters = [int(v) for v in np.asarray(state["counters"])]
-        # snapshots written before dropped_skew joined the array carry 4
-        # entries; missing counters resume at 0
-        counters += [0] * (5 - len(counters))
-        warmed, stale, overflow, ingested, skew = counters[:5]
+        # snapshots written before dropped_skew/dropped_nonfinite joined
+        # the array carry fewer entries; missing counters resume at 0
+        counters += [0] * (6 - len(counters))
+        warmed, stale, overflow, ingested, skew, nonfinite = counters[:6]
         self._warmed = bool(warmed)
         self.dropped_stale = stale
         self.dropped_overflow = overflow
         self.ingested = ingested
         self.dropped_skew = skew
+        self.dropped_nonfinite = nonfinite
 
     def _publish_telemetry(self) -> None:
         """Mirror the host-side bookkeeping into the registry (gauges are
@@ -504,6 +560,9 @@ class TrajectoryBuffer:
             float(self.dropped_overflow)
         )
         self._tel.gauge("buffer/dropped_skew").set(float(self.dropped_skew))
+        self._tel.gauge("buffer/dropped_nonfinite").set(
+            float(self.dropped_nonfinite)
+        )
 
     def metrics(self) -> Dict[str, float]:
         return {
@@ -512,4 +571,5 @@ class TrajectoryBuffer:
             "buffer_dropped_stale": float(self.dropped_stale),
             "buffer_dropped_overflow": float(self.dropped_overflow),
             "buffer_dropped_skew": float(self.dropped_skew),
+            "buffer_dropped_nonfinite": float(self.dropped_nonfinite),
         }

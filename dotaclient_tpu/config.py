@@ -230,6 +230,27 @@ class BufferConfig:
     # batch already assembled in the prefetch lane is consumed without
     # reaching the drain at all (train/learner.py `_next_batch`).
     consume_poll_timeout_s: float = 0.001
+    # Admission control (ISSUE 6): semantic integrity at the buffer door,
+    # extending the wire-integrity discipline (CRC + poison-peer
+    # quarantine, ISSUE 4) to payload CONTENT.
+    #
+    # max_weight_staleness: absolute version-delta bound for admission —
+    # a frame whose producer version is more than this many optimizer
+    # versions behind is rejected and counted
+    # (buffer/stale_rejected_total). -1 (default) derives the bound from
+    # ppo.max_staleness × steps_per_batch, the historical behavior; >= 0
+    # overrides it with a raw version delta (the knob thousand-actor
+    # fleets tune directly — IMPACT's soundness argument needs staleness
+    # BOUNDED at ingest, not merely observed).
+    max_weight_staleness: int = -1
+    # reject_nonfinite: scan every float leaf of a host-ingested payload
+    # (observations, rewards, behavior logp, carries) and reject frames
+    # carrying NaN/Inf (buffer/nonfinite_rejected_total) — one actor with
+    # corrupted state must not poison the learner's numerics. Device-path
+    # ingest (add_device) skips the scan: those chunks are produced
+    # in-process by construction and divergence there is the train-step
+    # probe's job (train/health.py).
+    reject_nonfinite: bool = True
 
 
 @dataclasses.dataclass(frozen=True)
@@ -305,6 +326,43 @@ class LearnerConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class HealthConfig:
+    """Training health guardian (ISSUE 6): detect → contain → recover.
+
+    Detection is a cheap in-graph probe fused into every train-step
+    variant (``train/ppo.py`` adds a ``health_ok`` finiteness flag over
+    loss and grad-norm to the step metrics; scanned multi-update programs
+    AND-fold it), surfaced WITHOUT blocking the train thread: the
+    ``HealthMonitor`` (train/health.py) accumulates the per-batch verdict
+    scalars host-side and the snapshot engine fetches them in one batched
+    transfer per boundary — ordered BEFORE the publish job, so a poisoned
+    version can never reach the weights fanout. Containment: unhealthy
+    state blocks weight publishes and periodic checkpoints (actors keep
+    serving the last good version). Recovery: divergence rolls the
+    TrainState back to the ``last_good`` checkpoint slot
+    (utils/checkpoint.py) with a distinct minibatch-RNG stream, bounded by
+    ``max_rollbacks`` before a loud exit."""
+
+    enabled: bool = True
+    # Host-side EMA of the (pre-clip) gradient global norm, updated on
+    # healthy verdicts only; a verdict whose grad_norm exceeds
+    # explosion_band × the EMA latches divergence even when every value is
+    # still finite — the "loss exploded but has not NaN'd yet" band. The
+    # EMA arms after warmup_steps healthy verdicts (early training swings
+    # legitimately). Band is deliberately wide by default: the finiteness
+    # probe is the primary tripwire; the band exists to catch runaway
+    # growth before it saturates to inf.
+    ema_alpha: float = 0.02
+    explosion_band: float = 100.0
+    warmup_steps: int = 50
+    # Divergence rollbacks attempted (each restores last_good and resumes
+    # with a DISTINCT minibatch-shuffle RNG stream) before the guardian
+    # declares the run unrecoverable and exits non-zero with the runbook
+    # message (docs/OPERATIONS.md "Failure modes").
+    max_rollbacks: int = 3
+
+
+@dataclasses.dataclass(frozen=True)
 class LeagueConfig:
     enabled: bool = False
     pool_size: int = 8
@@ -352,6 +410,7 @@ class RunConfig:
     buffer: BufferConfig = BufferConfig()
     transport: TransportConfig = TransportConfig()
     learner: LearnerConfig = LearnerConfig()
+    health: HealthConfig = HealthConfig()
     league: LeagueConfig = LeagueConfig()
     checkpoint_dir: str = "checkpoints"
     checkpoint_every: int = 100
@@ -397,6 +456,8 @@ class RunConfig:
             transport=TransportConfig(**raw.get("transport", {})),
             # .get: absent in checkpoints written before LearnerConfig
             learner=LearnerConfig(**raw.get("learner", {})),
+            # .get: absent in checkpoints written before HealthConfig
+            health=HealthConfig(**raw.get("health", {})),
             league=LeagueConfig(**raw["league"]),
             # .get: absent in checkpoints written before the field existed
             checkpoint_best_min_episodes=raw.get(

@@ -1,8 +1,25 @@
 """Version-compat shims shared by the parallelism modules."""
 
+import jax
+
 try:
     from jax import shard_map
 except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
-__all__ = ["shard_map"]
+
+def pcast_varying(x, axis_names):
+    """``jax.lax.pcast(x, axis_names, to="varying")`` where it exists.
+
+    The varying-type annotation (and the carry-type checking that makes it
+    necessary inside shard_map loops) only exists in newer jax; on older
+    versions (this container's 0.4.x) there is nothing to annotate and the
+    identity is exactly equivalent — the fori_loop carries type-check
+    without it."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is None:
+        return x
+    return pcast(x, axis_names, to="varying")
+
+
+__all__ = ["shard_map", "pcast_varying"]

@@ -26,7 +26,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from dotaclient_tpu.parallel._compat import shard_map
+from dotaclient_tpu.parallel._compat import pcast_varying, shard_map
 
 AXIS = "data"  # default mesh axis to shard the sequence over
 
@@ -117,7 +117,8 @@ def ring_attention_shard(
     def varying(x):
         # constants are axis-invariant; the loop outputs are axis-varying —
         # mark the init carries varying so the fori_loop types match
-        return jax.lax.pcast(x, (axis_name,), to="varying")
+        # (identity on jax versions without varying types — _compat shim)
+        return pcast_varying(x, (axis_name,))
 
     init = (
         varying(jnp.zeros((B, Tl, h, d), jnp.float32)),

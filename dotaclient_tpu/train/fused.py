@@ -47,7 +47,11 @@ import jax.numpy as jnp
 from dotaclient_tpu.config import RunConfig
 from dotaclient_tpu.models.policy import Policy
 from dotaclient_tpu.parallel.mesh import data_sharding, replicated
-from dotaclient_tpu.train.ppo import _train_step, train_state_sharding
+from dotaclient_tpu.train.ppo import (
+    _train_step,
+    fold_scan_metrics,
+    train_state_sharding,
+)
 
 
 def make_fused_step(
@@ -82,17 +86,20 @@ def make_fused_step(
             f"n_lanes must be divisible by minibatches ({n_mb})"
         )
 
+    probe = config.health.enabled
+
     def update_on_chunk(state, chunk):
         if n_epochs == 1 and n_mb == 1:
             return _train_step(
-                policy, config.ppo, state, chunk, anchor_params=anchor_params
+                policy, config.ppo, state, chunk,
+                anchor_params=anchor_params, probe=probe,
             )
 
         def epoch(st, _):
             if n_mb == 1:
                 return _train_step(
                     policy, config.ppo, st, chunk,
-                    anchor_params=anchor_params,
+                    anchor_params=anchor_params, probe=probe,
                 )
             # In-program shuffle: the permutation is keyed on the run seed
             # and the optimizer step at epoch entry (strictly increasing,
@@ -114,18 +121,20 @@ def make_fused_step(
                     lambda x: jax.lax.with_sharding_constraint(x, ds), mb
                 )
                 return _train_step(
-                    policy, config.ppo, s, mb, anchor_params=anchor_params
+                    policy, config.ppo, s, mb,
+                    anchor_params=anchor_params, probe=probe,
                 )
 
             st, mseq = jax.lax.scan(mb_step, st, mbs)
-            return st, jax.tree.map(lambda m: m[-1], mseq)
+            return st, fold_scan_metrics(mseq)
 
         new_state, metric_seq = jax.lax.scan(
             epoch, state, None, length=n_epochs
         )
         # report the final update (the state reflects it), like the
-        # buffered loop's last logged step of a multi-epoch pass
-        return new_state, jax.tree.map(lambda m: m[-1], metric_seq)
+        # buffered loop's last logged step of a multi-epoch pass;
+        # health_ok AND-folds across every scan level (fold_scan_metrics)
+        return new_state, fold_scan_metrics(metric_seq)
 
     def one_iter(state, actor_state, opp_params):
         actor_state, chunk, stats = actor._rollout_impl(
@@ -156,7 +165,7 @@ def make_fused_step(
             (state, actor_state), (metric_seq, stat_seq) = jax.lax.scan(
                 it, (state, actor_state), None, length=n_iters
             )
-            metrics = jax.tree.map(lambda m: m[-1], metric_seq)
+            metrics = fold_scan_metrics(metric_seq)
             stats = jax.tree.map(lambda s: s.sum(axis=0), stat_seq)
             return state, actor_state, metrics, stats
 

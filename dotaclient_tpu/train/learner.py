@@ -351,6 +351,28 @@ class Learner:
         # restores the inline behavior for debugging.
         self._snap_engine = None
         self._snap_copy = None
+        # Training health guardian (ISSUE 6, train/health.py): the
+        # in-graph probe's verdict scalars accumulate host-side per
+        # consumed batch (zero device traffic) and are flushed as ONE
+        # batched fetch through the snapshot engine at boundary cadence —
+        # ordered before the publish job, so the publish gate is sound
+        # without the train thread ever blocking on a verdict. On a
+        # latched divergence the loop rolls the TrainState back to the
+        # last_good checkpoint slot (bounded retries, distinct minibatch
+        # RNG, loud exit when exhausted).
+        self._health = None
+        if config.health.enabled:
+            from dotaclient_tpu.train.health import HealthMonitor
+
+            self._health = HealthMonitor(config.health)
+        self._rollback_count = 0
+        # Device references to the LAST batch's verdict scalars (sync-mode
+        # checkpoint/tail folds — see _sync_fold_latest).
+        self._last_verdict_m = None
+        # Highest version actually handed to the fanout on the SYNC path
+        # (async mode asks the engine); the rollback audit line reports
+        # whichever is live as its published-floor evidence.
+        self._published_version = -1
         # Deferred best-model candidate, written by the snapshot thread's
         # metrics continuation and consumed on the train thread; the lock
         # makes the read-and-clear swap atomic against a concurrent write
@@ -365,6 +387,7 @@ class Learner:
                 transport=self.transport,
                 wire_dtype=config.transport.wire_dtype,
                 ckpt=self.ckpt,
+                health=self._health,
             )
             self._snap_copy = jax.jit(lambda t: jax.tree.map(jnp.copy, t))
         # eager-create the stall gauges (and, sync mode, the snapshot keys
@@ -574,6 +597,15 @@ class Learner:
             raise RuntimeError(
                 "injected fault: learner.fail_train_step (chaos harness)"
             )
+        if self._faults is not None and self._faults.fire("learner.nan_grad"):
+            # Divergence injection (ISSUE 6 chaos): one NaN reward poisons
+            # the loss and the whole backward pass — the realistic NaN-
+            # gradient shape — placed on the dispatch path (a tiny jitted
+            # scatter, no host↔device sync). The health probe must flag
+            # the step, the publish gate must hold the version back, and
+            # rollback must restore last_good.
+            batch = dict(batch)
+            batch["rewards"] = batch["rewards"].at[0, 0].set(jnp.nan)
         cfg = self.config.ppo
         M = max(1, cfg.minibatches)
         E = cfg.epochs_per_batch
@@ -595,6 +627,7 @@ class Learner:
             self._dispatch_inflight = True
             self._host_step += E * M
             self._host_version += E * M
+            self._submit_health(m)
             return m
         for _ in range(E):
             if M == 1:
@@ -617,6 +650,7 @@ class Learner:
                 self._dispatch_inflight = True
                 self._host_step += 1
                 self._host_version += 1
+        self._submit_health(m)
         return m
 
     def _next_batch(self, drain_transport: bool = True):
@@ -734,6 +768,209 @@ class Learner:
             for _ in range(self._mb_draws):
                 self._mb_rng.permutation(self.config.ppo.batch_rollouts)
 
+    def _submit_health(self, m) -> None:
+        """Queue this batch's verdict scalars with the health monitor —
+        a host-side append of three device scalars (program outputs, never
+        donated); the boundary flush ships the whole backlog to the
+        snapshot engine in ONE batched fetch. In sync-snapshots mode the
+        boundary metrics fetch folds the verdicts instead (``fold_host``,
+        zero extra transfers); the last batch's verdict leaves are kept
+        either way so sync checkpoint boundaries and the end-of-run tail
+        can close their coverage gap (``_sync_fold_latest``)."""
+        if self._health is None:
+            return
+        from dotaclient_tpu.train.health import VERDICT_KEYS
+
+        self._last_verdict_m = {k: m[k] for k in VERDICT_KEYS if k in m}
+        if self._snap_engine is not None:
+            self._health.submit(self._host_step, self._host_version, m)
+
+    def _sync_fold_latest(self) -> None:
+        """--sync-snapshots gap-closer: verdicts normally fold from the
+        log-boundary metrics fetch, but a checkpoint boundary (or the
+        end-of-run forced save) that is NOT a log boundary must not mark a
+        state ``last_good`` on stale knowledge — fold the LAST batch's
+        verdict scalars first (one tiny fetch at checkpoint/tail cadence;
+        sync mode stalls by design)."""
+        if (
+            self._health is None
+            or self._snap_engine is not None
+            or self._last_verdict_m is None
+        ):
+            return
+        host = jax.device_get(self._last_verdict_m)  # host-sync-ok: sync-snapshots checkpoint/tail cadence, three scalars
+        self._health.fold_host(self._host_step, self._host_version, host)
+
+    def _flush_health(self) -> None:
+        """Hand every pending verdict to the snapshot engine's
+        never-coalesced stats backlog. The engine processes stats jobs
+        BEFORE the same cycle's publish/checkpoint jobs, so a publish
+        submitted after this flush can only run once every verdict for
+        steps ≤ its version has been folded — the ordering that makes the
+        publish gate sound."""
+        if self._health is None or self._snap_engine is None:
+            return
+        pending = self._health.take_pending()
+        if pending:
+            self._snap_engine.submit_stats(pending, self._health.fold_batch)
+
+    def _maybe_rollback(self) -> int:
+        """Recover from a latched divergence: restore the last_good
+        checkpoint, abandon the poisoned timeline (its checkpoints, its
+        buffered experience, its recurrent actor carries), resume with a
+        DISTINCT minibatch-RNG stream, and return how many optimizer steps
+        were rewound (0 when healthy) so the caller's step budget covers
+        the retraining. Bounded by ``health.max_rollbacks``; exhaustion —
+        or a run with no checkpoint manager to restore from — exits loudly
+        with the runbook pointer (docs/OPERATIONS.md "Failure modes")."""
+        if self._health is None or self._health.unhealthy is None:
+            return 0
+        ev = self._health.unhealthy
+        if self.ckpt is None:
+            # contain-only degrade: without a checkpoint dir there is no
+            # restore point — publishes stay blocked (actors keep the last
+            # good version) and the operator is told once, loudly.
+            if self._health.note_unrecoverable():
+                print(
+                    f"WARNING: training health latched unhealthy "
+                    f"({ev.reason} at step {ev.step}) but no "
+                    f"--checkpoint-dir is configured — cannot roll back; "
+                    f"weight publishes stay BLOCKED (see docs/OPERATIONS.md "
+                    f"'Failure modes')",
+                    flush=True,
+                )
+            return 0
+        runbook = (
+            "see docs/OPERATIONS.md 'Failure modes' (divergence runbook): "
+            "inspect the batch data and learning rate, consider "
+            "--ppo kl_target/max_grad_norm, and restart from "
+            "<checkpoint_dir>/last_good"
+        )
+        # exhaustion check BEFORE counting: the give-up path performs no
+        # restore, so it must not inflate health/rollbacks_total
+        if self._rollback_count >= self.config.health.max_rollbacks:
+            raise RuntimeError(
+                f"training health guardian: divergence persisted after "
+                f"{self.config.health.max_rollbacks} rollback(s) "
+                f"({ev.reason} at step {ev.step}, value {ev.value!r}) — "
+                f"giving up; {runbook}"
+            )
+        self._rollback_count += 1
+        self.telemetry.counter("health/rollbacks_total").inc()
+        # Drain the engine FIRST, with the monitor still latched: any
+        # pending publish/checkpoint job of the poisoned timeline hits the
+        # engine-side health gate and is refused — clearing the latch
+        # before the drain would let one slip through.
+        self._drain_snapshots()
+        published_floor = (
+            self._snap_engine.last_published
+            if self._snap_engine is not None
+            else self._published_version
+        )
+        restored = self.ckpt.restore_last_good(self.config, self.state)
+        if restored is None:
+            # no verified slot yet (divergence before the first healthy
+            # checkpoint): fall back to the newest manifest-valid main
+            # save — every main save was itself health-gated
+            try:
+                restored = self.ckpt.restore(self.config, self.state)
+            except (FileNotFoundError, ValueError, RuntimeError) as e:
+                raise RuntimeError(
+                    f"training health guardian: divergence at step "
+                    f"{ev.step} ({ev.reason}) and no restorable checkpoint "
+                    f"to roll back to ({type(e).__name__}: {e}) — {runbook}"
+                ) from e
+        state, _ = restored
+        from_step, from_version = self._host_step, self._host_version
+        restored_version = int(np.asarray(state.version))  # host-sync-ok: rollback cadence, host-bound restore
+        # The VERSION counter stays monotone across the rollback AND skips
+        # past the poisoned range entirely: the restored state resumes at
+        # from_version + 1, so every version the poisoned steps produced —
+        # (restored_version, from_version] — is never reused on the wire
+        # and "no actor ever applied a poisoned version" becomes a
+        # checkable set invariant (chaos divergence scenario); the
+        # engine's monotonic-publish floor needs no rewind. Steps DO
+        # rewind (the retraining re-earns them); step and version diverge
+        # from here on, which nothing downstream assumes away.
+        resumed_version = from_version + 1
+        self.state = dataclasses.replace(
+            state, version=jnp.asarray(resumed_version, jnp.int32)
+        )
+        self._host_step = int(np.asarray(state.step))      # host-sync-ok: rollback cadence
+        self._host_version = resumed_version
+        rewound = from_step - self._host_step
+        # the abandoned timeline's saves must not be restorable (and the
+        # retrained timeline re-reaches their step numbers)
+        self.ckpt.discard_steps_above(self._host_step)
+        # experience produced by the poisoned policy is dropped (slots
+        # tagged with a version inside the poisoned range); the prefetch
+        # lane is flushed first so held slots fold back in
+        if self.buffer is not None:
+            self._flush_prefetch()
+            self.buffer.drop_newer_than(restored_version)
+        # recurrent carries computed by poisoned params must not leak into
+        # the restored run (the sim worlds themselves stay finite)
+        if self.device_actor is not None:
+            self.device_actor.reset_recurrent()
+        elif self.pool is not None and hasattr(self.pool, "set_params"):
+            self.pool.set_params(self._actor_params_copy(), self._host_version)
+        # distinct RNG resume: the retry must not replay the exact
+        # minibatch permutation stream that diverged
+        self._mb_rng = np.random.default_rng(
+            self.config.seed + 1 + 7919 * self._rollback_count
+        )
+        self._mb_draws = 0
+        # the poisoned batch's verdict scalars must not be re-folded into
+        # the cleared monitor by the next sync-mode boundary (fold_host
+        # folds with the CURRENT generation — clear() alone doesn't shield)
+        self._last_verdict_m = None
+        self._health.clear()
+        self.telemetry.gauge("health/last_good_step").set(
+            float(self._host_step)   # host-sync-ok: host int mirror
+        )
+        # machine-readable audit line (scripts/chaos_run.py divergence
+        # scenario): published_floor ≤ to_version proves no poisoned
+        # version ever reached the actor fleet
+        print(
+            "HEALTH_ROLLBACK " + json.dumps(
+                {
+                    "reason": ev.reason,
+                    "detected_step": ev.step,
+                    # the first version the flagged update produced: the
+                    # POISONED range is [detected_version, resumed_version)
+                    # — versions between the restore point and detection
+                    # were produced by verdict-clean steps and may have
+                    # been legitimately published before the latch
+                    "detected_version": ev.version,
+                    "from_step": from_step,
+                    "to_step": self._host_step,
+                    "restored_version": restored_version,
+                    "resumed_version": resumed_version,
+                    "published_floor": published_floor,
+                    "rollback": self._rollback_count,
+                },
+                sort_keys=True,
+            ),
+            flush=True,
+        )
+        return rewound
+
+    def _push_pool_params(self, params) -> None:
+        """In-process weight refresh (``pool.set_params``) behind the same
+        health gate as the transport publish paths: a latched-unhealthy
+        monitor blocks the push (counted in ``health/publish_blocked_total``)
+        so in-proc actors keep serving the last good params too — the
+        contain promise must hold whether actors are across a wire or in
+        this process. The rollback path pushes restored params directly
+        (the monitor is cleared by then)."""
+        if self._health is not None:
+            if self._snap_engine is None:
+                self._sync_fold_latest()
+            if self._health.unhealthy is not None:
+                self.telemetry.counter("health/publish_blocked_total").inc()
+                return
+        self.pool.set_params(params, self._host_version)
+
     def _publish_weights(self) -> None:
         """Hand the current params to the weights fanout (call at refresh
         cadence, not per step). Async (the default): one jitted on-device
@@ -742,21 +979,39 @@ class Learner:
         and the non-blocking fanout enqueue — the train thread never waits
         on the host. Sync (``--sync-snapshots``): everything inline, with
         ONE batched fetch inside :func:`encode_weights`. Either way the
-        fanout itself never blocks on a stalled actor
-        (socket_transport.py)."""
+        fanout itself never blocks on a stalled actor (socket_transport.py),
+        and a latched-unhealthy monitor blocks the publish entirely — the
+        contain stage of the health guardian (ISSUE 6): actors keep
+        serving the last good version."""
         t0 = time.perf_counter()
         if self._snap_engine is not None:
+            # verdicts for every step ≤ this version reach the engine
+            # before the publish job (stats-before-jobs ordering): the
+            # engine-side gate sees a current latch, never a stale one
+            self._flush_health()
             self._snap_engine.submit_publish(
                 self._snap_copy(self.state.params), self._host_version
             )
         else:
-            with self.telemetry.span("transport/publish_weights"):
-                self.transport.publish_weights(
-                    encode_weights(
-                        self.state.params,   # one batched fetch inside
-                        self._host_version,
-                        wire_dtype=self.config.transport.wire_dtype,
+            # sync mode folds verdicts at LOG cadence, but the gate below
+            # must see the last batch's verdict even when the refresh
+            # boundary isn't a log boundary — same gap-closer the sync
+            # checkpoint branch uses (a poisoned publish is exactly the
+            # fanout this gate exists to stop)
+            self._sync_fold_latest()
+            if self._health is not None and self._health.unhealthy is not None:
+                self.telemetry.counter("health/publish_blocked_total").inc()
+            else:
+                with self.telemetry.span("transport/publish_weights"):
+                    self.transport.publish_weights(
+                        encode_weights(
+                            self.state.params,   # one batched fetch inside
+                            self._host_version,
+                            wire_dtype=self.config.transport.wire_dtype,
+                        )
                     )
+                self._published_version = max(
+                    self._published_version, self._host_version
                 )
         stall = time.perf_counter() - t0
         self._stall_s += stall
@@ -996,7 +1251,11 @@ class Learner:
             self.transport, InProcTransport
         )
 
-        def after_step(m, frames: Optional[int] = None) -> None:
+        def after_step(m, frames: Optional[int] = None) -> int:
+            """Boundary side effects for one loop iteration. Returns the
+            number of optimizer steps a divergence rollback rewound (0 on
+            the healthy path) — callers subtract it from their step budget
+            so the run still completes to its target step."""
             nonlocal frames_trained
             frames_trained += (
                 frames
@@ -1004,6 +1263,13 @@ class Learner:
                 else cfg.ppo.batch_rollouts * cfg.ppo.rollout_len
             )
             step = self._host_step
+            if step % cfg.log_every < stride or (
+                self.ckpt and step % cfg.checkpoint_every < stride
+            ):
+                # ship pending health verdicts ahead of this boundary's
+                # jobs (one batched fetch on the snapshot thread); the
+                # publish branch flushes inside _publish_weights itself
+                self._flush_health()
             if step % cfg.log_every < stride:
                 t0 = time.perf_counter()
                 # a best-model save the async metrics continuation deferred
@@ -1058,6 +1324,13 @@ class Learner:
                     # the fetch blocked on the dispatched step — overlap
                     # window for prefetch accounting closes here
                     self._dispatch_inflight = False
+                    if self._health is not None:
+                        # sync-mode health verdicts fold from the boundary
+                        # scalars just fetched — zero extra transfers,
+                        # detection at log cadence
+                        self._health.fold_host(
+                            step, self._host_version, scalars
+                        )
                     scalars.update(host_extra)
                     self._maybe_save_best(scalars)
                     if self._best_dir is not None:
@@ -1078,12 +1351,32 @@ class Learner:
                 t0 = time.perf_counter()
                 if self._snap_engine is not None:
                     # one cheap on-device copy of the WHOLE TrainState; the
-                    # snapshot thread fetches it (one transfer) and writes
+                    # snapshot thread fetches it (one transfer), health-
+                    # gates it (verdicts ≤ this step land first — flushed
+                    # above), and writes
                     self._snap_engine.submit_checkpoint(
                         self._snap_copy(self.state), cfg
                     )
                 else:
-                    self.ckpt.save(self.state, cfg)
+                    # sync mode: log-boundary folds may not cover THIS
+                    # step (checkpoint_every and log_every need not align)
+                    # — fold the latest verdict before gating, or a
+                    # poisoned state could earn the last_good mark
+                    self._sync_fold_latest()
+                    if (
+                        self._health is not None
+                        and self._health.unhealthy is not None
+                    ):
+                        # contain (sync mode): a poisoned state never
+                        # enters the rolling retention
+                        self.telemetry.counter(
+                            "health/checkpoints_blocked_total"
+                        ).inc()
+                    else:
+                        self.ckpt.save(
+                            self.state, cfg,
+                            mark_good=self._health is not None,
+                        )
                 self._stall_s += time.perf_counter() - t0
             if (
                 publish_midrun
@@ -1091,142 +1384,167 @@ class Learner:
                 and step % (refresh_every * stride) < stride
             ):
                 self._publish_weights()
+            return self._maybe_rollback()
 
-        if self.fused_step is not None:
-            # Fused mode: rollout + update is ONE program; each dispatch
-            # runs steps_per_dispatch iterations of epochs_per_batch
-            # optimizer steps (train/fused.py). Train batch = the lane set.
-            da = self.device_actor
-            k_iters = cfg.steps_per_dispatch
-            frames_per = da.n_lanes * cfg.ppo.rollout_len * k_iters
-            while steps_done < num_steps and not self._stop_requested:
-                opp_params, opp_idx = self._league_opponent()
-                if opp_params is None:       # self-play / scripted: one
-                    opp_params = self.state.params   # signature for all modes
-                self.state, da.state, m, chunk_stats = self.fused_step(
-                    self.state, da.state, opp_params
-                )
-                self._report_league(opp_idx, chunk_stats)
-                # the program ran `stride` optimizer steps over K chunks —
-                # keep the host mirrors in lockstep with the device counters
-                self._host_step += stride
-                self._host_version += stride
-                da.env_steps += frames_per
-                da.rollouts_shipped += da.n_lanes * k_iters
-                steps_done += stride
-                after_step(m, frames=frames_per)
-        elif self.device_actor is not None:
-            # On-device rollout mode: collect→ingest→train is all dispatch
-            # (the device serializes rollout and train programs back-to-back,
-            # so a host thread would add nothing; `overlap` is a no-op here).
-            # The prefetch lane still earns its keep: batch N+1's gather is
-            # issued behind batch N's epoch step, so the host-side take/
-            # bookkeeping cost never sits between two dispatches.
-            da = self.device_actor
-            while steps_done < num_steps and not self._stop_requested:
-                opp_params, opp_idx = self._league_opponent()
-                chunk, chunk_stats = da.collect(
-                    self.state.params, opp_params=opp_params
-                )
-                self._report_league(opp_idx, chunk_stats)
-                self.buffer.add_device(chunk, self._host_version)
-                while (
-                    batch := self._next_batch(drain_transport=False)
-                ) is not None:
-                    m = self._optimize(batch)
-                    if steps_done + epochs < num_steps:
-                        # there is a next step to feed; a batch staged
-                        # behind the FINAL dispatch could never be consumed
-                        # and would only be requeued at the flush below
-                        self._prefetch_next(drain_transport=False)
-                    steps_done += epochs
-                    after_step(m)
-                    if steps_done >= num_steps or self._stop_requested:
-                        break
-        elif self.actor_mode == "external":
-            # Experience arrives from standalone actor processes over the
-            # transport; this loop only trains and publishes weights. The
-            # transport drain + host-row staging + scatter + gather for
-            # batch N+1 run behind batch N's dispatched step (prefetch).
-            self._publish_weights()
-            while steps_done < num_steps and not self._stop_requested:
-                batch = self._next_batch()
-                if batch is None:
-                    time.sleep(0.005)
-                    continue
-                m = self._optimize(batch)
-                if steps_done + epochs < num_steps:   # see device loop
-                    self._prefetch_next()
-                steps_done += epochs
-                after_step(m)
-                if refresh_every and (steps_done // epochs) % refresh_every == 0:
-                    self._publish_weights()
-        elif overlap:
-            stop = threading.Event()
-            actor_error: List[BaseException] = []
-
-            def actor_loop() -> None:
-                try:
-                    while not stop.is_set():
-                        self.pool.step()
-                except BaseException as e:  # surface, never swallow
-                    actor_error.append(e)
-
-            self.pool.set_params(self._actor_params_copy(), self._host_version)
-            actor_thread = threading.Thread(
-                target=actor_loop, name="actor", daemon=True
-            )
-            actor_thread.start()
-            try:
+        def _run_mode_loop() -> None:
+            """One pass of the mode-specific training loop, until
+            ``steps_done`` reaches ``num_steps`` or a stop is
+            requested. Factored so the tail's divergence-rollback
+            check (ISSUE 6) can re-enter it: a health verdict that
+            folds only after the loop hits its target must still be
+            able to roll back AND retrain to the exact target step."""
+            nonlocal steps_done
+            if self.fused_step is not None:
+                # Fused mode: rollout + update is ONE program; each dispatch
+                # runs steps_per_dispatch iterations of epochs_per_batch
+                # optimizer steps (train/fused.py). Train batch = the lane set.
+                da = self.device_actor
+                k_iters = cfg.steps_per_dispatch
+                frames_per = da.n_lanes * cfg.ppo.rollout_len * k_iters
                 while steps_done < num_steps and not self._stop_requested:
-                    if actor_error:
-                        raise RuntimeError(
-                            "actor thread died; learner cannot make progress"
-                        ) from actor_error[0]
+                    opp_params, opp_idx = self._league_opponent()
+                    if opp_params is None:       # self-play / scripted: one
+                        opp_params = self.state.params   # signature for all modes
+                    self.state, da.state, m, chunk_stats = self.fused_step(
+                        self.state, da.state, opp_params
+                    )
+                    self._report_league(opp_idx, chunk_stats)
+                    # the program ran `stride` optimizer steps over K chunks —
+                    # keep the host mirrors in lockstep with the device counters
+                    self._host_step += stride
+                    self._host_version += stride
+                    da.env_steps += frames_per
+                    da.rollouts_shipped += da.n_lanes * k_iters
+                    self._submit_health(m)
+                    steps_done += stride
+                    steps_done -= after_step(m, frames=frames_per)
+            elif self.device_actor is not None:
+                # On-device rollout mode: collect→ingest→train is all dispatch
+                # (the device serializes rollout and train programs back-to-back,
+                # so a host thread would add nothing; `overlap` is a no-op here).
+                # The prefetch lane still earns its keep: batch N+1's gather is
+                # issued behind batch N's epoch step, so the host-side take/
+                # bookkeeping cost never sits between two dispatches.
+                da = self.device_actor
+                while steps_done < num_steps and not self._stop_requested:
+                    opp_params, opp_idx = self._league_opponent()
+                    chunk, chunk_stats = da.collect(
+                        self.state.params, opp_params=opp_params
+                    )
+                    self._report_league(opp_idx, chunk_stats)
+                    self.buffer.add_device(chunk, self._host_version)
+                    while (
+                        batch := self._next_batch(drain_transport=False)
+                    ) is not None:
+                        m = self._optimize(batch)
+                        if steps_done + epochs < num_steps:
+                            # there is a next step to feed; a batch staged
+                            # behind the FINAL dispatch could never be consumed
+                            # and would only be requeued at the flush below
+                            self._prefetch_next(drain_transport=False)
+                        steps_done += epochs
+                        steps_done -= after_step(m)
+                        if steps_done >= num_steps or self._stop_requested:
+                            break
+            elif self.actor_mode == "external":
+                # Experience arrives from standalone actor processes over the
+                # transport; this loop only trains and publishes weights. The
+                # transport drain + host-row staging + scatter + gather for
+                # batch N+1 run behind batch N's dispatched step (prefetch).
+                self._publish_weights()
+                while steps_done < num_steps and not self._stop_requested:
                     batch = self._next_batch()
                     if batch is None:
-                        time.sleep(0.002)
+                        time.sleep(0.005)
                         continue
                     m = self._optimize(batch)
                     if steps_done + epochs < num_steps:   # see device loop
                         self._prefetch_next()
                     steps_done += epochs
-                    after_step(m)
+                    steps_done -= after_step(m)
                     if refresh_every and (steps_done // epochs) % refresh_every == 0:
-                        self.pool.set_params(
-                            self._actor_params_copy(), self._host_version
-                        )
-                        self._refresh_league_opponent()
-            finally:
-                stop.set()
-                actor_thread.join(timeout=30.0)
-        else:
-            while steps_done < num_steps and not self._stop_requested:
-                # Actor phase: generate experience with the current weights.
-                self.pool.set_params(self.state.params, self._host_version)
-                self._refresh_league_opponent()
-                self.pool.run(actor_steps, refresh_every=0)
-                self.ingest()
-                # Learner phase: drain full batches; each iteration stages
-                # the next batch behind the in-flight dispatch.
-                while (batch := self._next_batch()) is not None:
-                    m = self._optimize(batch)
-                    if steps_done + epochs < num_steps:   # see device loop
-                        self._prefetch_next()
-                    steps_done += epochs
-                    after_step(m)
-                    if steps_done >= num_steps or self._stop_requested:
-                        break
-        # End-of-call prefetch flush: a batch staged behind the final
-        # dispatch was never trained on — return it to the ring so the
-        # final checkpoint (and the next train() call) see it.
-        if self.buffer is not None:
-            self._flush_prefetch()
-        self._dispatch_inflight = False
-        # Async boundary jobs still in flight must land before the tail
-        # reads/mutates the shared stats below (and any deferred best-model
-        # save applies); the snapshot thread is idle afterwards.
-        self._drain_snapshots()
+                        self._publish_weights()
+            elif overlap:
+                stop = threading.Event()
+                actor_error: List[BaseException] = []
+
+                def actor_loop() -> None:
+                    try:
+                        while not stop.is_set():
+                            self.pool.step()
+                    except BaseException as e:  # surface, never swallow
+                        actor_error.append(e)
+
+                self.pool.set_params(self._actor_params_copy(), self._host_version)
+                actor_thread = threading.Thread(
+                    target=actor_loop, name="actor", daemon=True
+                )
+                actor_thread.start()
+                try:
+                    while steps_done < num_steps and not self._stop_requested:
+                        if actor_error:
+                            raise RuntimeError(
+                                "actor thread died; learner cannot make progress"
+                            ) from actor_error[0]
+                        batch = self._next_batch()
+                        if batch is None:
+                            time.sleep(0.002)
+                            continue
+                        m = self._optimize(batch)
+                        if steps_done + epochs < num_steps:   # see device loop
+                            self._prefetch_next()
+                        steps_done += epochs
+                        steps_done -= after_step(m)
+                        if refresh_every and (steps_done // epochs) % refresh_every == 0:
+                            self._push_pool_params(self._actor_params_copy())
+                            self._refresh_league_opponent()
+                finally:
+                    stop.set()
+                    actor_thread.join(timeout=30.0)
+            else:
+                while steps_done < num_steps and not self._stop_requested:
+                    # Actor phase: generate experience with the current weights.
+                    self._push_pool_params(self.state.params)
+                    self._refresh_league_opponent()
+                    self.pool.run(actor_steps, refresh_every=0)
+                    self.ingest()
+                    # Learner phase: drain full batches; each iteration stages
+                    # the next batch behind the in-flight dispatch.
+                    while (batch := self._next_batch()) is not None:
+                        m = self._optimize(batch)
+                        if steps_done + epochs < num_steps:   # see device loop
+                            self._prefetch_next()
+                        steps_done += epochs
+                        steps_done -= after_step(m)
+                        if steps_done >= num_steps or self._stop_requested:
+                            break
+        _run_mode_loop()
+        while True:
+            # End-of-call prefetch flush: a batch staged behind the final
+            # dispatch was never trained on — return it to the ring so the
+            # final checkpoint (and the next train() call) see it.
+            if self.buffer is not None:
+                self._flush_prefetch()
+            self._dispatch_inflight = False
+            # Async boundary jobs still in flight must land before the tail
+            # reads/mutates the shared stats below (and any deferred
+            # best-model save applies); the snapshot thread is idle
+            # afterwards. Pending health verdicts flush first so the
+            # tail's publish/save gates see the final steps' verdicts.
+            self._flush_health()
+            self._drain_snapshots()
+            # Tail rollback check (ISSUE 6): on a fast run the engine can
+            # fold the poisoned verdict only AFTER the loop hit its step
+            # target — containment already held (the gates were latched
+            # before anything left the learner), but the run must not be
+            # SEALED on poisoned params: roll back and re-enter the loop
+            # so it still completes to the exact target step. Bounded by
+            # health.max_rollbacks like every rollback.
+            rewound = self._maybe_rollback()
+            if not rewound or self._stop_requested:
+                break
+            steps_done -= rewound
+            _run_mode_loop()
         if self.device_actor is not None:
             # End-of-call drain: the windowed stats cover this train() call
             # (the demo's block cadence) — the second best-model hook, so
@@ -1243,10 +1561,21 @@ class Learner:
         if self.ckpt:
             # The forced end-of-run/drain save stays SYNC (the snapshot
             # thread is drained and idle): it lands at the EXACT stop step
-            # and an I/O failure here raises loudly (ISSUE 4 policy).
+            # and an I/O failure here raises loudly (ISSUE 4 policy). It is
+            # NEVER health-blocked — exact-step resume outranks hygiene —
+            # but only a verdict-clean state earns the last_good mark (a
+            # divergence detected in the final steps restores through the
+            # guardian on the next --restore instead). Sync mode folds the
+            # final batch's verdict first — its last log boundary may
+            # predate the final steps.
+            self._sync_fold_latest()
             self.ckpt.save(
                 self.state, cfg, force=True,
                 pipeline=self._pipeline_state(),
+                mark_good=(
+                    self._health is not None
+                    and self._health.unhealthy is None
+                ),
             )
             self.ckpt.wait()
         elapsed = time.time() - t_start
@@ -1280,6 +1609,12 @@ def main(argv=None) -> Dict[str, float]:
         "record; schema in docs/ARCHITECTURE.md 'Observability'",
     )
     p.add_argument("--checkpoint-dir", type=str, default=None)
+    p.add_argument(
+        "--checkpoint-every", type=int, default=None,
+        help="optimizer steps between periodic checkpoints (default "
+        "RunConfig.checkpoint_every); the chaos divergence scenario "
+        "tightens this so a last_good restore point exists early",
+    )
     p.add_argument("--restore", action="store_true")
     p.add_argument("--init-from", type=str, default=None, metavar="DIR",
                    help="seed a fresh run with the params of the latest "
@@ -1316,6 +1651,12 @@ def main(argv=None) -> Dict[str, float]:
         "--buffer", type=str, default=None, metavar="K=V,...",
         help="comma-separated BufferConfig overrides, e.g. "
         "'capacity_rollouts=64,min_fill=8'",
+    )
+    p.add_argument(
+        "--health", type=str, default=None, metavar="K=V,...",
+        help="comma-separated HealthConfig overrides (training health "
+        "guardian, ISSUE 6), e.g. 'explosion_band=50,max_rollbacks=2' or "
+        "'enabled=false'",
     )
     p.add_argument(
         "--sync-snapshots", action="store_true",
@@ -1458,8 +1799,13 @@ def main(argv=None) -> Dict[str, float]:
         config = dataclasses.replace(
             config, steps_per_dispatch=args.steps_per_dispatch
         )
+    if args.checkpoint_every is not None:
+        config = dataclasses.replace(
+            config, checkpoint_every=args.checkpoint_every
+        )
     from dotaclient_tpu.config import (
         BufferConfig,
+        HealthConfig,
         LeagueConfig,
         PPOConfig,
         RewardConfig,
@@ -1474,6 +1820,7 @@ def main(argv=None) -> Dict[str, float]:
         ("--reward", args.reward, "reward", RewardConfig),
         ("--league", args.league, "league", LeagueConfig),
         ("--buffer", args.buffer, "buffer", BufferConfig),
+        ("--health", args.health, "health", HealthConfig),
     ):
         if not text:
             continue

@@ -268,6 +268,7 @@ def _train_step(
     state: TrainState,
     batch: Batch,
     anchor_params: Any = None,
+    probe: bool = True,
 ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
     grad_fn = jax.value_and_grad(
         lambda p: ppo_loss(
@@ -378,6 +379,18 @@ def _train_step(
         metrics["post_kl"] = post_kl
         metrics["lr"] = lr
     metrics["grad_norm"] = optax.global_norm(grads)
+    if probe:
+        # Training-health probe (ISSUE 6, train/health.py): one scalar AND
+        # over the two values every step already computes. loss covers
+        # NaN/Inf anywhere in the forward/returns path (non-finite params
+        # from a previous step included); the PRE-clip gradient global
+        # norm covers a backward pass that NaN'd after a finite loss.
+        # Scanned multi-update programs AND-fold this flag
+        # (fold_scan_metrics), so one poisoned update taints the whole
+        # program's verdict.
+        metrics["health_ok"] = (
+            jnp.isfinite(metrics["loss"]) & jnp.isfinite(metrics["grad_norm"])
+        ).astype(jnp.float32)
     new_state = dataclasses.replace(
         state,
         step=state.step + 1,
@@ -386,6 +399,19 @@ def _train_step(
         opt_state=opt_state,
     )
     return new_state, metrics
+
+
+def fold_scan_metrics(metric_seq: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+    """Reduce a ``lax.scan``'s stacked per-update metrics to one report:
+    the LAST update's values (the state reflects it — the historical
+    contract of every scanned train path), except ``health_ok``, which
+    AND-folds (min) across the scan — a single poisoned update inside a
+    fused multi-update program must taint the program's verdict even when
+    later updates happen to report finite values again."""
+    out = jax.tree.map(lambda m: m[-1], metric_seq)
+    if "health_ok" in metric_seq:
+        out["health_ok"] = metric_seq["health_ok"].min()
+    return out
 
 
 def train_state_sharding(policy: Policy, config: RunConfig, mesh: Mesh):
@@ -450,7 +476,8 @@ def make_train_step(
 
         inner = checkify.checkify(
             lambda state, batch: _train_step(
-                policy, config.ppo, state, batch, anchor_params=anchor_params
+                policy, config.ppo, state, batch, anchor_params=anchor_params,
+                probe=config.health.enabled,
             ),
             errors=checkify.float_checks,
         )
@@ -464,7 +491,8 @@ def make_train_step(
         return checked_step
     step_fn = jax.jit(
         lambda state, batch: _train_step(
-            policy, config.ppo, state, batch, anchor_params=anchor_params
+            policy, config.ppo, state, batch, anchor_params=anchor_params,
+            probe=config.health.enabled,
         ),
         in_shardings=(state_sharding, batch_shardings),
         out_shardings=(state_sharding, metrics_repl),
@@ -541,15 +569,17 @@ def make_epoch_step(
                     batch,
                 )
             return _train_step(
-                policy, cfg, st, sub, anchor_params=anchor_params
+                policy, cfg, st, sub, anchor_params=anchor_params,
+                probe=config.health.enabled,
             )
 
         # [E, B] → [E·M, mb]: scan one optimizer step per slice; epoch e's
         # minibatches are rows e·M..(e+1)·M of the reshape, exactly the
-        # slices the staged loop gathers.
+        # slices the staged loop gathers. health_ok AND-folds across the
+        # scan (fold_scan_metrics) so one poisoned update taints the batch.
         idx = perms.reshape(E * M, mb)
         state, metric_seq = jax.lax.scan(body, state, idx)
-        return state, jax.tree.map(lambda m: m[-1], metric_seq)
+        return state, fold_scan_metrics(metric_seq)
 
     return jax.jit(
         epoch_step,

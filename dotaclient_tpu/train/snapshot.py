@@ -79,10 +79,19 @@ class SnapshotEngine:
         wire_dtype: str = "float32",
         ckpt: Any = None,
         registry: Optional[telemetry.Registry] = None,
+        health: Any = None,
     ) -> None:
         self._transport = transport
         self._wire_dtype = wire_dtype
         self._ckpt = ckpt
+        # Training-health gate (ISSUE 6, train/health.py): verdicts ride
+        # the never-coalesced stats backlog, which this thread processes
+        # BEFORE the same cycle's publish/checkpoint jobs — so by the time
+        # a version-V publish job runs, every verdict for steps <= V has
+        # been folded. An unhealthy latch refuses the publish (actors keep
+        # serving the last good version) and the periodic checkpoint (the
+        # retention loop must not rotate good saves out for poisoned ones).
+        self._health = health
         self._tel = registry if registry is not None else telemetry.get_registry()
         self._cond = threading.Condition()
         self._jobs: Dict[str, Optional[Tuple]] = {k: None for k in _KINDS}
@@ -263,7 +272,27 @@ class SnapshotEngine:
         )
         return host
 
+    @property
+    def last_published(self) -> int:
+        """Highest version ever handed to the fanout (the rollback
+        audit's published-floor evidence — train/learner.py; rollback
+        keeps the version counter monotone, so the floor never needs a
+        rewind)."""
+        return self._last_published
+
     def _do_publish(self, params: Any, version: int) -> None:
+        if self._health is not None and self._health.unhealthy is not None:
+            # contain: a flagged step's params never reach the wire; the
+            # fanout keeps serving the last good version until rollback
+            self._tel.counter("health/publish_blocked_total").inc()
+            logger.warning(
+                "snapshot: publish of version %d BLOCKED — training "
+                "health latched unhealthy (%s at step %d); actors keep "
+                "the last good weights",
+                version, self._health.unhealthy.reason,
+                self._health.unhealthy.step,
+            )
+            return
         if version <= self._last_published:
             return  # stale re-submit (drain/tail overlap): monotonic wins
         from dotaclient_tpu.transport.serialize import encode_weights
@@ -275,6 +304,19 @@ class SnapshotEngine:
         self._last_published = version
 
     def _do_checkpoint(self, state: Any, config: Any) -> None:
+        healthy = True
+        if self._health is not None:
+            if self._health.unhealthy is not None:
+                # contain: a poisoned TrainState must not enter the rolling
+                # retention (it would eventually GC the last healthy save —
+                # the exact failure mode ISSUE 6 exists to close)
+                self._tel.counter("health/checkpoints_blocked_total").inc()
+                logger.warning(
+                    "snapshot: periodic checkpoint BLOCKED — training "
+                    "health latched unhealthy; awaiting rollback",
+                )
+                return
+            healthy = self._health.cfg.enabled
         host = self._fetch(
             {
                 "step": state.step,
@@ -285,8 +327,11 @@ class SnapshotEngine:
         )
         # periodic cadence (force=False): I/O failures degrade to the
         # checkpoint/save_failures_total counter inside save_host — exactly
-        # the policy a sync periodic save follows
-        self._ckpt.save_host(host, config, force=False)
+        # the policy a sync periodic save follows. With the guardian on,
+        # every verdict <= this state's step has already been folded (the
+        # stats backlog precedes this job), so a save that reaches here is
+        # health-verified: mirror it into the last_good slot.
+        self._ckpt.save_host(host, config, force=False, mark_good=healthy)
 
     def _do_metrics(
         self, device_tree: Any, finish: Callable[[Any], None]

@@ -4,6 +4,23 @@ The reference does ``torch.save(state_dict)`` every K steps with a resume
 flag (SURVEY.md §5.4; reconstructed — the reference checkout was an empty
 mount). Here a checkpoint restores the *exact* training step: params,
 optimizer state, step/version counters, and the config that produced them.
+
+Integrity + retention (ISSUE 6, the guardian's *recover* stage):
+
+* every save writes a sidecar **integrity manifest**
+  (``<dir>/manifests/<step>.json``: per-leaf shape/dtype + content
+  digest, reusing the transport layer's memory-bandwidth CRC fold) from
+  the host arrays already in hand — no extra device traffic;
+* every restore **verifies** the manifest and *walks back*: a corrupt or
+  unreadable latest step is counted (``checkpoint/manifest_failures_total``),
+  warned about, and skipped in favor of the previous manifest-valid save
+  — a torn write or bit-rotted leaf degrades restore granularity instead
+  of crashing the relaunch;
+* a ``last_good`` **retention slot** (``<dir>/last_good``, its own
+  max_to_keep=1 manager) holds the newest save whose steps the health
+  guardian verified — outside the main rolling GC, so divergence rollback
+  (train/learner.py) always has a healthy restore point even after the
+  main retention loop has moved on.
 """
 
 from __future__ import annotations
@@ -24,6 +41,67 @@ from dotaclient_tpu.train.ppo import TrainState, init_train_state
 from dotaclient_tpu.utils import faults, telemetry
 
 logger = logging.getLogger(__name__)
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """A restored checkpoint failed its integrity-manifest verification."""
+
+
+def _plain_tree(tree: Any) -> Any:
+    """Canonicalize a state tree to orbax's storage shape: NamedTuples
+    (optax states) become dicts of their fields, tuples become lists.
+    Saved trees carry the live NamedTuple nodes while a template-free
+    restore returns plain dicts — the manifest must hash BOTH to the same
+    leaf paths or every verified restore would read as corrupt."""
+    if hasattr(tree, "_fields"):   # NamedTuple
+        return {k: _plain_tree(v) for k, v in tree._asdict().items()}
+    if isinstance(tree, dict):
+        return {k: _plain_tree(v) for k, v in tree.items()}
+    if isinstance(tree, (tuple, list)):
+        return [_plain_tree(v) for v in tree]
+    return tree
+
+
+def build_manifest(host_state: Any) -> dict:
+    """Per-leaf shape/dtype/digest record of an already-fetched host state
+    tree. The digest is the transport layer's CRC fold
+    (``serialize.frame_crc32`` — XOR-fold + CRC32, memory-bandwidth fast),
+    so manifest cost is one pass over bytes the save writes anyway."""
+    from dotaclient_tpu.transport.serialize import frame_crc32
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(_plain_tree(host_state))
+    leaves = {}
+    for path, leaf in flat:
+        a = np.ascontiguousarray(leaf)
+        leaves[jax.tree_util.keystr(path)] = {
+            "shape": list(a.shape),
+            "dtype": str(a.dtype),
+            "crc": frame_crc32(a.tobytes()),
+        }
+    return {"version": 1, "leaves": leaves}
+
+
+def verify_manifest(manifest: dict, host_state: Any) -> None:
+    """Raise :class:`CheckpointIntegrityError` on the first leaf whose
+    shape, dtype, or content digest differs from the manifest (or on a
+    leaf-set mismatch)."""
+    got = build_manifest(host_state)["leaves"]
+    want = manifest.get("leaves", {})
+    if set(got) != set(want):
+        missing = sorted(set(want) - set(got))[:3]
+        extra = sorted(set(got) - set(want))[:3]
+        raise CheckpointIntegrityError(
+            f"leaf set differs from manifest (missing {missing}, "
+            f"unexpected {extra})"
+        )
+    for key, spec in want.items():
+        g = got[key]
+        for field in ("shape", "dtype", "crc"):
+            if g[field] != spec[field]:
+                raise CheckpointIntegrityError(
+                    f"leaf {key!r} {field} mismatch: restored "
+                    f"{g[field]!r} != saved {spec[field]!r}"
+                )
 
 
 def shape_mismatches(got: Any, want: Any) -> list:
@@ -60,7 +138,9 @@ class CheckpointManager:
     # forced save raises loudly instead of parking forever.
     LOCK_TIMEOUT_S = 120.0
 
-    def __init__(self, directory: str, max_to_keep: int = 3) -> None:
+    def __init__(
+        self, directory: str, max_to_keep: int = 3, _is_slot: bool = False
+    ) -> None:
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self._mgr = ocp.CheckpointManager(
@@ -77,9 +157,82 @@ class CheckpointManager:
         # in the graceful path makes overlap rare, but the lock makes it
         # impossible.
         self._save_lock = threading.Lock()
-        # eager-create: a run that never fails a save still reports the 0
-        # (check_telemetry_schema.py --require-faults pins this key)
+        # last_good retention slot (ISSUE 6): a nested manager holding the
+        # newest health-verified save, outside the main rolling GC.
+        # Lazily created at the first mark_good save; _is_slot stops the
+        # nesting at one level (the slot has no slot).
+        self._is_slot = _is_slot
+        self._slot_mgr: Optional["CheckpointManager"] = None
+        # The step a walk-back restore actually landed on (may be older
+        # than latest when the newest save failed integrity); pipeline
+        # restore follows it so state and pipeline never come from
+        # different steps.
+        self.last_restored_step: Optional[int] = None
+        # eager-create: a run that never fails a save still reports the 0s
+        # (check_telemetry_schema.py --require-faults / --require-health)
         self._tel.counter("checkpoint/save_failures_total")
+        self._tel.counter("checkpoint/manifest_failures_total")
+
+    # -- integrity manifests -------------------------------------------------
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self.directory, "manifests", f"{step}.json")
+
+    def _write_manifest(self, step: int, host_state: Any) -> None:
+        """Sidecar write (temp+rename, like every marker in this repo);
+        failure degrades — a save without a manifest restores unverified,
+        exactly like a pre-ISSUE-6 checkpoint."""
+        try:
+            os.makedirs(os.path.join(self.directory, "manifests"), exist_ok=True)
+            path = self._manifest_path(step)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"step": step, **build_manifest(host_state)}, f)
+            os.replace(tmp, path)
+        except OSError as e:
+            logger.warning(
+                "checkpoint manifest write for step %d failed (%s) — the "
+                "save stands but will restore UNVERIFIED", step, e,
+            )
+
+    def _gc_manifests(self) -> None:
+        """Drop sidecars whose step the rolling retention already deleted."""
+        mdir = os.path.join(self.directory, "manifests")
+        try:
+            names = os.listdir(mdir)
+        except OSError:
+            return
+        live = set(self._mgr.all_steps())
+        for name in names:
+            stem = name[:-5] if name.endswith(".json") else ""
+            if stem.isdigit() and int(stem) not in live:
+                try:
+                    os.unlink(os.path.join(mdir, name))
+                except OSError:
+                    pass
+
+    def _verify_step(self, step: int, host_state: Any) -> None:
+        """Verify ``host_state`` against step's manifest. A step without a
+        manifest (legacy writer, failed sidecar write) passes unverified;
+        a manifest that exists but mismatches — or an injected
+        ``checkpoint.corrupt_manifest`` fault — raises
+        :class:`CheckpointIntegrityError`."""
+        if self._faults is not None and self._faults.fire(
+            "checkpoint.corrupt_manifest"
+        ):
+            raise CheckpointIntegrityError(
+                "injected fault: checkpoint.corrupt_manifest (chaos harness)"
+            )
+        try:
+            with open(self._manifest_path(step)) as f:
+                manifest = json.load(f)
+        except FileNotFoundError:
+            return
+        except (OSError, json.JSONDecodeError) as e:
+            raise CheckpointIntegrityError(
+                f"manifest for step {step} unreadable: {e}"
+            ) from e
+        verify_manifest(manifest, host_state)
 
     def save(
         self,
@@ -87,6 +240,7 @@ class CheckpointManager:
         config: RunConfig,
         force: bool = False,
         pipeline: Optional[Any] = None,
+        mark_good: bool = False,
     ) -> bool:
         """Save the train state (+ config); ``pipeline`` optionally carries
         the rest of the system — trajectory-buffer contents/cursors and the
@@ -110,7 +264,10 @@ class CheckpointManager:
         )
         if pipeline is not None:
             pipeline = jax.device_get(pipeline)  # host-sync-ok: one batched fetch, forced/end-of-run cadence
-        return self.save_host(host_state, config, force=force, pipeline=pipeline)
+        return self.save_host(
+            host_state, config, force=force, pipeline=pipeline,
+            mark_good=mark_good,
+        )
 
     def save_host(
         self,
@@ -118,6 +275,7 @@ class CheckpointManager:
         config: RunConfig,
         force: bool = False,
         pipeline: Optional[Any] = None,
+        mark_good: bool = False,
     ) -> bool:
         """Write an already-fetched host-array state dict (``step``,
         ``version``, ``params``, ``opt_state``) — no device traffic; the
@@ -140,10 +298,9 @@ class CheckpointManager:
         else:
             injected = None
         step = int(np.asarray(host_state["step"]))  # host-sync-ok: host array
+        host_np = jax.tree.map(np.asarray, host_state)  # host-sync-ok: host arrays (int leaves → np scalars for orbax)
         items = dict(
-            state=ocp.args.StandardSave(
-                jax.tree.map(np.asarray, host_state)  # host-sync-ok: host arrays (int leaves → np scalars for orbax)
-            ),
+            state=ocp.args.StandardSave(host_np),
             config=ocp.args.JsonSave(dataclasses.asdict(config)),
         )
         if pipeline is not None:
@@ -164,11 +321,56 @@ class CheckpointManager:
             logger.warning("%s", msg)
             return False
         try:
-            return self._save_host_locked(
+            saved = self._save_host_locked(
                 step, items, force, pipeline, injected
             )
+            if saved:
+                # Sidecar integrity manifest (digest of the host arrays
+                # just handed to orbax) + sidecar GC for steps the rolling
+                # retention dropped. Written before orbax's async finalize
+                # completes — a finalize-time failure surfaces at the next
+                # save's join and that step then fails restore loudly, the
+                # same outcome as a digest mismatch.
+                self._write_manifest(step, host_np)
+                self._gc_manifests()
+                if mark_good and not self._is_slot:
+                    self._save_last_good(step, host_np, config)
+            return saved
         finally:
             self._save_lock.release()
+
+    def _save_last_good(self, step: int, host_np: Any, config: RunConfig) -> None:
+        """Mirror a health-verified save into the ``last_good`` slot (its
+        own max_to_keep=1 manager — the main rolling GC can never eat it).
+        Best-effort: slot I/O failure degrades to the save-failure counter;
+        the main save already stands."""
+        try:
+            slot = self._last_good_slot()
+            if step in slot._mgr.all_steps():
+                # a rollback-then-retrain run re-reaches old step numbers;
+                # the fresh (re-verified) save supersedes the stale slot
+                slot._wait_for_prev_save()
+                slot._mgr.delete(step)
+            slot.save_host(
+                {k: host_np[k] for k in ("step", "version", "params", "opt_state")},
+                config, force=True,
+            )
+            self._tel.gauge("health/last_good_step").set(float(step))   # host-sync-ok: host int
+        except Exception as e:  # noqa: BLE001 - protection layer, never fatal
+            self._tel.counter("checkpoint/save_failures_total").inc()
+            logger.warning(
+                "last_good slot update at step %d failed (%s: %s) — the "
+                "main save stands; rollback protection is stale until the "
+                "next healthy checkpoint", step, type(e).__name__, e,
+            )
+
+    def _last_good_slot(self) -> "CheckpointManager":
+        if self._slot_mgr is None:
+            self._slot_mgr = CheckpointManager(
+                os.path.join(self.directory, "last_good"),
+                max_to_keep=1, _is_slot=True,
+            )
+        return self._slot_mgr
 
     def _save_host_locked(
         self,
@@ -197,27 +399,29 @@ class CheckpointManager:
         try:
             if injected is not None:
                 raise injected
-            # A periodic (weights-only) save and the end-of-run pipeline
-            # save land on the SAME step whenever the run length is a
-            # multiple of checkpoint_every; orbax refuses to overwrite an
-            # existing step. The pipeline save strictly supersedes the
-            # weights-only one, so replace it; without new content there
-            # is nothing to add — skip.
-            if step in self._mgr.all_steps():
-                if pipeline is None:
-                    return False
+            # A save can land on a step that already exists: the end-of-run
+            # pipeline save supersedes the periodic weights-only save on the
+            # same step, and a divergence-rollback run (ISSUE 6) legitimately
+            # RE-REACHES step numbers of its abandoned timeline (whose saves
+            # rollback discards, but a walk-back-skipped corrupt step can
+            # linger). orbax refuses to overwrite; the newest content always
+            # supersedes, so replace.
+            replacing = step in self._mgr.all_steps()
+            if replacing:
                 self._wait_for_prev_save()
                 self._mgr.delete(step)
-                # the replacement save MUST NOT be declined: with
-                # force=False orbax's should_save rejects any step <=
-                # latest, which after the delete would mean guaranteed
-                # loss of step `step`. (A crash between delete and save
-                # durability can still lose it — replace-in-place is not
-                # atomic; the periodic saves around it bound the damage
-                # to one checkpoint interval.)
-                force = True
+            # the replacement save MUST NOT be declined: with force=False
+            # orbax's should_save rejects any step <= latest, which after
+            # the delete would mean guaranteed loss of step `step`. (A
+            # crash between delete and save durability can still lose it —
+            # replace-in-place is not atomic; the periodic saves around it
+            # bound the damage to one checkpoint interval.) Only the orbax
+            # decline-override escalates: the raise-vs-degrade policy below
+            # stays the CALLER's `force` — a periodic save that happens to
+            # collide must still degrade on I/O failure, not kill the run.
             saved = self._mgr.save(
-                step, args=ocp.args.Composite(**items), force=force
+                step, args=ocp.args.Composite(**items),
+                force=force or replacing,
             )
         except (OSError, ValueError, RuntimeError) as e:
             if force:
@@ -232,13 +436,55 @@ class CheckpointManager:
             return False
         return bool(saved)
 
-    def restore_pipeline(self, template: Any) -> Tuple[Optional[Any], str]:
-        """Restore the pipeline extras of the latest step into ``template``'s
-        structure. Returns (state, "") on success; (None, "") when the
-        checkpoint simply has no pipeline entry; (None, reason) when one
-        exists but could not be restored (shape/layout mismatch) — callers
-        must surface that loudly, not silently degrade."""
-        step = self._mgr.latest_step()
+    def _restore_stepwise(self, attempt) -> Any:
+        """Walk the saved steps newest-first, calling ``attempt(step)``
+        until one succeeds; every failing step — an orbax read error, a
+        layout mismatch, or an integrity-manifest failure raised inside
+        ``attempt`` — is counted (``checkpoint/manifest_failures_total``),
+        warned about, and skipped in favor of the previous save. A corrupt
+        LATEST checkpoint therefore degrades restore granularity by one
+        interval instead of crashing the relaunch (ISSUE 6). Re-raises the
+        last error when every step fails."""
+        steps = sorted(self._mgr.all_steps(), reverse=True)
+        if not steps:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        last_err: Optional[BaseException] = None
+        for i, step in enumerate(steps):
+            try:
+                out = attempt(step)
+            except (
+                CheckpointIntegrityError, KeyError, FileNotFoundError,
+                OSError, ValueError, TypeError, RuntimeError,
+            ) as e:
+                last_err = e
+                self._tel.counter("checkpoint/manifest_failures_total").inc()
+                logger.warning(
+                    "checkpoint restore at step %d failed integrity/read "
+                    "(%s: %s) — %s", step, type(e).__name__, e,
+                    "walking back to the previous save"
+                    if i + 1 < len(steps) else "no older save to walk back to",
+                )
+                continue
+            self.last_restored_step = step
+            return out
+        raise last_err  # type: ignore[misc]  # loop ran: steps is non-empty
+
+    def restore_pipeline(
+        self, template: Any, step: Optional[int] = None
+    ) -> Tuple[Optional[Any], str]:
+        """Restore the pipeline extras into ``template``'s structure —
+        from ``step``, defaulting to the step the preceding state restore
+        landed on (walk-back aware), else the latest. Returns (state, "")
+        on success; (None, "") when the checkpoint simply has no pipeline
+        entry; (None, reason) when one exists but could not be restored
+        (shape/layout mismatch) — callers must surface that loudly, not
+        silently degrade."""
+        if step is None:
+            step = (
+                self.last_restored_step
+                if self.last_restored_step is not None
+                else self._mgr.latest_step()
+            )
         if step is None:
             return None, ""
         try:
@@ -303,9 +549,55 @@ class CheckpointManager:
             self._wait_for_prev_save()
         finally:
             self._save_lock.release()
+        if self._slot_mgr is not None:
+            # the last_good slot write finalizes on its own orbax thread;
+            # an interpreter exiting before it lands races the executor
+            # shutdown ("cannot schedule new futures") — join it too
+            self._slot_mgr.wait()
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
+
+    # -- last_good retention slot (ISSUE 6) ---------------------------------
+
+    def last_good_step(self) -> Optional[int]:
+        """Step held by the ``last_good`` slot, or None when the guardian
+        has not yet verified a save (fresh run, or health disabled)."""
+        slot_dir = os.path.join(self.directory, "last_good")
+        if self._slot_mgr is None and not os.path.isdir(slot_dir):
+            return None
+        return self._last_good_slot().latest_step()
+
+    def restore_last_good(
+        self, config: RunConfig, abstract_state: Optional[TrainState] = None
+    ) -> Optional[Tuple[TrainState, RunConfig]]:
+        """Restore the last health-verified save (divergence rollback's
+        restore point). None when the slot is empty; integrity-verified
+        like every restore."""
+        if self.last_good_step() is None:
+            return None
+        return self._last_good_slot().restore(config, abstract_state)
+
+    def discard_steps_above(self, step: int) -> int:
+        """Delete every save newer than ``step`` (divergence rollback:
+        checkpoints of the abandoned timeline must not be restorable, and
+        the retrained timeline will re-reach their step numbers). Returns
+        the number of deleted saves."""
+        if not self._save_lock.acquire(timeout=self.LOCK_TIMEOUT_S):
+            raise RuntimeError(
+                f"checkpoint writer lock not acquired within "
+                f"{self.LOCK_TIMEOUT_S:.0f}s — cannot discard the "
+                f"abandoned timeline's saves"
+            )
+        try:
+            self._wait_for_prev_save()
+            doomed = [s for s in self._mgr.all_steps() if s > step]
+            for s in doomed:
+                self._mgr.delete(s)
+            self._gc_manifests()
+            return len(doomed)
+        finally:
+            self._save_lock.release()
 
     def _latest_step_or_raise(self) -> int:
         step = self._mgr.latest_step()
@@ -340,15 +632,18 @@ class CheckpointManager:
         ``init_from`` path does); the source's opt_state is ignored
         entirely, matching init_from's fresh-moments contract.
         """
-        step = self._latest_step_or_raise()
-        restored = self._mgr.restore(
-            step, args=ocp.args.Composite(state=ocp.args.StandardRestore())
-        )
-        raw = restored["state"]
-        return (
-            jax.tree.map(jax.numpy.asarray, raw["params"]),
-            int(np.asarray(raw["step"])),
-        )
+        def attempt(step: int):
+            restored = self._mgr.restore(
+                step, args=ocp.args.Composite(state=ocp.args.StandardRestore())
+            )
+            raw = restored["state"]
+            self._verify_step(step, raw)
+            return (
+                jax.tree.map(jax.numpy.asarray, raw["params"]),
+                int(np.asarray(raw["step"])),   # host-sync-ok: restored host array
+            )
+
+        return self._restore_stepwise(attempt)
 
     def restore(
         self, config: RunConfig, abstract_state: Optional[TrainState] = None
@@ -358,7 +653,6 @@ class CheckpointManager:
         ``abstract_state`` provides the target pytree structure; built from
         ``config`` when omitted.
         """
-        step = self._latest_step_or_raise()
         if abstract_state is None:
             from dotaclient_tpu.models import init_params, make_policy
 
@@ -371,22 +665,28 @@ class CheckpointManager:
             "params": jax.tree.map(np.asarray, abstract_state.params),
             "opt_state": jax.tree.map(np.asarray, abstract_state.opt_state),
         }
-        restored = self._mgr.restore(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(template),
-                config=ocp.args.JsonRestore(),
-            ),
-        )
-        raw = restored["state"]
-        state = TrainState(
-            step=jax.numpy.asarray(raw["step"]),
-            version=jax.numpy.asarray(raw["version"]),
-            params=jax.tree.map(jax.numpy.asarray, raw["params"]),
-            opt_state=jax.tree.map(jax.numpy.asarray, raw["opt_state"]),
-        )
-        cfg = self._decode_config(restored["config"])
-        return state, cfg
+
+        def attempt(step: int):
+            restored = self._mgr.restore(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(template),
+                    config=ocp.args.JsonRestore(),
+                ),
+            )
+            raw = restored["state"]
+            self._verify_step(step, raw)
+            state = TrainState(
+                step=jax.numpy.asarray(raw["step"]),
+                version=jax.numpy.asarray(raw["version"]),
+                params=jax.tree.map(jax.numpy.asarray, raw["params"]),
+                opt_state=jax.tree.map(jax.numpy.asarray, raw["opt_state"]),
+            )
+            return state, self._decode_config(restored["config"])
+
+        return self._restore_stepwise(attempt)
 
     def close(self) -> None:
+        if self._slot_mgr is not None:
+            self._slot_mgr.close()
         self._mgr.close()

@@ -29,6 +29,16 @@ Sites wired in this repo (grep for the literal to find the hook):
   injected ``OSError`` (simulated full disk) on its Nth call.
 * ``learner.fail_train_step``  — ``Learner._optimize`` raises on its Nth
   call (exercises ``--on-crash-checkpoint``).
+* ``learner.nan_grad``         — ``Learner._optimize`` poisons its Nth
+  batch's rewards with NaN before dispatch (buffered train paths): the
+  realistic NaN-gradient divergence the training health guardian
+  (ISSUE 6, train/health.py) must detect, contain, and roll back.
+* ``checkpoint.corrupt_manifest`` — the Nth integrity-manifest
+  verification at restore fails as if the save were corrupt on disk
+  (exercises the walk-back-to-previous-valid-save path).
+* ``actor.nonfinite_payload``  — the vec actor pool poisons its Nth
+  shipped rollout's rewards with NaN (exercises the learner buffer's
+  semantic admission control, ``buffer/nonfinite_rejected_total``).
 
 Cost discipline: the registry is **None when disabled** — hot paths cache
 ``faults.get()`` once at construction and the steady-state cost is a single

@@ -98,17 +98,27 @@ class Supervisor:
                 f"chaos run exceeded --timeout {self.args.timeout}s"
             )
 
-    def _spawn_learner(self, phase: int, restore: bool) -> subprocess.Popen:
+    def _spawn_learner(
+        self,
+        phase: int,
+        restore: bool,
+        steps: Optional[int] = None,
+        faults: Optional[str] = None,
+        extra: Optional[List[str]] = None,
+    ) -> subprocess.Popen:
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"  # the harness topology is CPU-only
         env.pop("DOTA_FAULTS", None)  # faults target specific children
+        if faults:
+            # learner-side injection (the divergence scenario's NaN grad)
+            env["DOTA_FAULTS"] = faults
         # a pytest parent exports --xla_force_host_platform_device_count=8
         # (tests/conftest.py); 8 virtual devices would change the learner's
         # batch-shard divisibility rules mid-harness — children run plain
         env.pop("XLA_FLAGS", None)
         cmd = [
             sys.executable, "-m", "dotaclient_tpu.train.learner",
-            "--steps", str(self.args.steps),
+            "--steps", str(steps if steps is not None else self.args.steps),
             "--transport", "socket",
             "--listen", f"127.0.0.1:{self.port}",
             "--checkpoint-dir", self.ckpt_dir,
@@ -121,6 +131,7 @@ class Supervisor:
             "--refresh-every", "2",
             "--on-crash-checkpoint",
         ]
+        cmd += extra or []
         if restore:
             cmd += ["--restore", "--steps", str(self.args.resume_steps)]
         log = open(os.path.join(self.workdir, f"learner{phase}.log"), "w")
@@ -295,6 +306,133 @@ class Supervisor:
             summary["fail"] = "no actor was killed — schedule never ran"
         return summary
 
+    def run_divergence(self) -> Dict:
+        """ISSUE 6 acceptance scenario: an injected NaN gradient inside the
+        real multi-process topology must trigger automatic rollback to the
+        last-good checkpoint, the run must still complete to its target
+        step with exit 0, and no actor may ever have applied a poisoned
+        weight version.
+
+        Evidence chain: the learner's ``HEALTH_ROLLBACK`` audit line names
+        the poisoned version range ``[detected_version, resumed_version)``
+        (never reused — the version counter stays monotone across rollback
+        and skips past it) and the ``published_floor`` at rollback time;
+        each actor prints ``ACTOR_VERSIONS_SEEN`` (every version it ever
+        applied) at graceful exit. PASS requires: learner exit 0, final
+        checkpoint == the target step, ``health/rollbacks_total`` ≥ 1 and
+        ``health/nonfinite_steps_total`` ≥ 1 in the metrics stream,
+        ``published_floor`` < ``detected_version`` for every rollback, and
+        every actor's applied-version set disjoint from every poisoned
+        range."""
+        a = self.args
+        summary: Dict = {
+            "scenario": "divergence", "seed": a.seed, "port": self.port,
+        }
+        jsonl = os.path.join(self.workdir, "learner1.jsonl")
+        target = a.divergence_steps
+        learner = self._spawn_learner(
+            1, restore=False, steps=target,
+            faults=f"learner.nan_grad@{a.nan_at}",
+            extra=["--checkpoint-every", str(a.divergence_checkpoint_every)],
+        )
+        self._tend_actors()
+        rc = self._wait_exit(learner, "learner (divergence run)")
+        summary["learner_exit"] = rc
+        summary.update(self._stop_actors())
+        summary["final_step"] = _latest_ckpt_step(self.ckpt_dir)
+        summary["actor_restarts"] = self.actor_restarts
+
+        # telemetry evidence from the metrics stream (counters ride every
+        # line; the end-of-run snapshot closes the record)
+        rollbacks = nonfinite = 0.0
+        for rec in _jsonl_scalars(jsonl):
+            sc = rec.get("scalars", {})
+            rollbacks = max(rollbacks, sc.get("health/rollbacks_total") or 0.0)
+            nonfinite = max(
+                nonfinite, sc.get("health/nonfinite_steps_total") or 0.0
+            )
+        summary["rollbacks_total"] = rollbacks
+        summary["nonfinite_steps_total"] = nonfinite
+
+        # the learner's rollback audit lines → poisoned version ranges
+        events = []
+        try:
+            with open(os.path.join(self.workdir, "learner1.log")) as f:
+                for line in f:
+                    if line.startswith("HEALTH_ROLLBACK "):
+                        events.append(
+                            json.loads(line[len("HEALTH_ROLLBACK "):])
+                        )
+        except (OSError, json.JSONDecodeError):
+            pass
+        summary["rollback_events"] = events
+
+        # every version each actor ever applied (printed at graceful exit)
+        actor_versions: List[List[int]] = []
+        for i in range(a.actors):
+            versions: set = set()
+            try:
+                with open(os.path.join(self.workdir, f"actor{i}.log")) as f:
+                    for line in f:
+                        if line.startswith("ACTOR_VERSIONS_SEEN "):
+                            # union across restarted incarnations — any of
+                            # them could have applied a poisoned version
+                            versions.update(
+                                json.loads(line[len("ACTOR_VERSIONS_SEEN "):])
+                            )
+            except (OSError, json.JSONDecodeError):
+                pass
+            actor_versions.append(sorted(versions))
+        summary["actor_versions_seen"] = actor_versions
+
+        poisoned = set()
+        for ev in events:
+            # [detected_version, resumed_version): the flagged update's
+            # version through the last pre-rollback one. Versions between
+            # the restore point and detection were produced by
+            # verdict-clean steps — publishing them before the latch was
+            # legitimate, so they are NOT poison (resumed_version re-tags
+            # the restored good params; the learner skips the whole range).
+            poisoned.update(
+                range(ev["detected_version"], ev["resumed_version"])
+            )
+        leaked = sorted(
+            poisoned.intersection(v for vs in actor_versions for v in vs)
+        )
+        summary["poisoned_versions"] = sorted(poisoned)
+        summary["leaked_versions"] = leaked
+
+        if rc != 0:
+            summary["fail"] = "learner did not survive the NaN gradient"
+        elif summary["final_step"] != target:
+            summary["fail"] = (
+                f"run did not complete to its target step after rollback: "
+                f"expected final checkpoint {target}, got "
+                f"{summary['final_step']}"
+            )
+        elif rollbacks < 1 or not events:
+            summary["fail"] = "no divergence rollback was recorded"
+        elif nonfinite < 1:
+            summary["fail"] = "the NaN step was never counted by the probe"
+        elif any(
+            ev["published_floor"] >= ev["detected_version"] for ev in events
+        ):
+            summary["fail"] = (
+                "a version at/after the first flagged update was on the "
+                "wire before the rollback — the publish gate leaked"
+            )
+        elif leaked:
+            summary["fail"] = (
+                f"actors applied poisoned weight versions {leaked} — the "
+                f"publish gate leaked"
+            )
+        elif not any(actor_versions):
+            summary["fail"] = (
+                "no actor reported its applied versions — the fanout (or "
+                "the graceful actor drain) never happened"
+            )
+        return summary
+
     def cleanup(self) -> None:
         self.shutting_down = True
         # the learner too: a timed-out/failed plan must not orphan a live
@@ -323,6 +461,23 @@ def main(argv=None) -> int:
     p.add_argument("--corrupt-every", type=int, default=5,
                    help="actor 0 corrupts its corrupt-at'th frame and "
                    "every corrupt-every'th after")
+    p.add_argument("--scenario", choices=("baseline", "divergence"),
+                   default="baseline",
+                   help="baseline: kill/corrupt/SIGTERM/restore plan "
+                   "(ISSUE 4); divergence: injected NaN gradient → "
+                   "automatic last-good rollback, exact-target completion, "
+                   "poisoned versions never published (ISSUE 6)")
+    p.add_argument("--divergence-steps", type=int, default=24,
+                   help="divergence scenario: target optimizer steps the "
+                   "run must complete to despite the rollback")
+    p.add_argument("--nan-at", type=int, default=8,
+                   help="divergence scenario: poison the Nth optimizer "
+                   "batch's gradients (DOTA_FAULTS=learner.nan_grad@N; "
+                   "with minibatches=2 batch N lands at step 2N)")
+    p.add_argument("--divergence-checkpoint-every", type=int, default=6,
+                   help="divergence scenario: periodic checkpoint cadence "
+                   "(tight, so a last_good restore point exists before "
+                   "the NaN lands)")
     p.add_argument("--timeout", type=float, default=900.0)
     p.add_argument("--keep-workdir", action="store_true")
     args = p.parse_args(argv)
@@ -331,7 +486,11 @@ def main(argv=None) -> int:
         shutil.rmtree(args.workdir)
     sup = Supervisor(args)
     try:
-        summary = sup.run()
+        summary = (
+            sup.run_divergence()
+            if args.scenario == "divergence"
+            else sup.run()
+        )
     except (TimeoutError, RuntimeError) as e:
         summary = {"fail": str(e)}
     finally:

@@ -67,11 +67,17 @@ ALLOWED_FUNCS: Dict[str, Set[str]] = {
     "dotaclient_tpu/buffer/trajectory_buffer.py": {
         "__init__",
         "_matches_slot",
+        "_payload_finite",      # admission door: host arrays only (ISSUE 6)
         "state_dict",
         "load_state_dict",
         "_publish_telemetry",
         "metrics",
     },
+    # Health monitor (ISSUE 6): submit/take_pending run on the train
+    # thread and must stay host-only; the fold side receives ALREADY
+    # fetched scalars (the engine's one batched transfer) — its float()
+    # casts are annotated at the line.
+    "dotaclient_tpu/train/health.py": set(),
     # The snapshot engine IS the designated sync site (ISSUE 5): its one
     # batched fetch is annotated at the line, everything else must stay
     # host-only — no function-level pass.

@@ -104,6 +104,19 @@ FAULT_KEYS = (
     "checkpoint/save_failures_total",   # degraded periodic saves
 )
 
+# Training health guardian (ISSUE 6). Validated with --require-health
+# against any health-enabled learner run's JSONL (health.enabled defaults
+# on): the HealthMonitor eager-creates every one of these at construction —
+# in BOTH sync and async snapshot modes — so a clean run deterministically
+# reports zeros (buffer/stale_rejected_total is pinned by the monitor too,
+# covering bufferless fused runs).
+HEALTH_KEYS = (
+    "health/nonfinite_steps_total",     # NaN/Inf loss or grad-norm verdicts
+    "health/rollbacks_total",           # last_good restores performed
+    "health/last_good_step",            # newest health-verified save
+    "buffer/stale_rejected_total",      # admission-control staleness drops
+)
+
 
 def validate_lines(
     lines: List[str], extra_required: tuple = ()
@@ -194,6 +207,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         "valid against ANY learner run's JSONL — the learner eager-creates "
         "them in async and sync-snapshots modes alike",
     )
+    p.add_argument(
+        "--require-health", action="store_true",
+        help="also require the training-health-guardian keys (ISSUE 6); "
+        "valid against any learner run with health.enabled (the default) — "
+        "the HealthMonitor eager-creates them in both snapshot modes",
+    )
     args = p.parse_args(argv)
     extra: tuple = ()
     if args.require_transport:
@@ -204,6 +223,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         extra += FAULT_KEYS
     if args.require_snapshot:
         extra += SNAPSHOT_KEYS
+    if args.require_health:
+        extra += HEALTH_KEYS
 
     path = args.path
     if path is None:

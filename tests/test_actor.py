@@ -295,6 +295,32 @@ class TestConnectBackoff:
             )
         assert calls["n"] == 3
 
+    def test_abort_mid_backoff_raises_promptly(self):
+        """A graceful stop requested during the schedule abandons the
+        remaining attempts within one segment — a chaos-scale reconnect
+        budget must not outlive the supervisor's SIGTERM grace window
+        (ISSUE 6 divergence scenario: the drain's ACTOR_VERSIONS_SEEN
+        audit line depends on the actor reaching its clean exit)."""
+        import random
+
+        from dotaclient_tpu.actor.__main__ import connect_with_backoff
+
+        calls = {"n": 0}
+        flag = {"stop": False}
+
+        def dead():
+            calls["n"] += 1
+            flag["stop"] = True   # stop lands while we'd be backing off
+            raise ConnectionError("gone")
+
+        with pytest.raises(ConnectionError, match="stop requested"):
+            connect_with_backoff(
+                dead, max_attempts=10, sleep=lambda s: None,
+                rng=random.Random(0),
+                should_abort=lambda: flag["stop"],
+            )
+        assert calls["n"] == 1
+
     def test_jitter_desynchronizes_replicas(self):
         """Two replicas with different seeds must not sleep in lockstep
         (thundering-herd guard)."""

@@ -54,3 +54,39 @@ def test_chaos_run_end_to_end(tmp_path):
     assert summary["saved_step"] >= 1
     # exact resume: restored learner continued from the saved step
     assert summary["final_step"] == summary["saved_step"] + 10
+
+
+@pytest.mark.slow
+def test_chaos_divergence_scenario(tmp_path):
+    """ISSUE 6 acceptance: an injected NaN gradient in the real
+    multi-process topology triggers automatic last-good rollback, the run
+    completes to its exact target step with exit 0, and no actor ever
+    applied a version from the poisoned range."""
+    env = dict(os.environ)
+    env.pop("DOTA_FAULTS", None)   # the supervisor sets per-child specs
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "scripts", "chaos_run.py"),
+            "--scenario", "divergence",
+            "--workdir", str(tmp_path / "chaos"),
+            "--seed", "0",
+            "--timeout", "900",
+        ],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=960,
+    )
+    summary_lines = [
+        line for line in proc.stdout.splitlines()
+        if line.startswith("CHAOS_SUMMARY ")
+    ]
+    assert summary_lines, (
+        f"no CHAOS_SUMMARY emitted\nstdout:\n{proc.stdout[-3000:]}\n"
+        f"stderr:\n{proc.stderr[-3000:]}"
+    )
+    summary = json.loads(summary_lines[-1][len("CHAOS_SUMMARY "):])
+    assert proc.returncode == 0 and summary.get("ok"), summary
+    assert summary["learner_exit"] == 0
+    assert summary["rollbacks_total"] >= 1
+    assert summary["nonfinite_steps_total"] >= 1
+    assert summary["final_step"] == 24            # target reached exactly
+    assert summary["leaked_versions"] == []       # poison never published
+    assert any(summary["actor_versions_seen"])    # fanout really happened

@@ -31,6 +31,7 @@ def small_config():
 
 
 class TestKillAndResume:
+    @pytest.mark.slow   # tier-1 duration audit (ISSUE 6): ~114s on the reference container
     def test_resume_reproduces_metrics(self, tmp_path):
         cfg = small_config()
         ckdir = str(tmp_path / "ck")
@@ -61,6 +62,7 @@ class TestKillAndResume:
                 f"metric {k} diverged after resume: {a_metrics[k]} vs {b_metrics[k]}"
             )
 
+    @pytest.mark.slow   # tier-1 duration audit (ISSUE 6): ~43s on the reference container
     def test_fused_mode_resume_reproduces_metrics(self, tmp_path):
         """Fused mode has no buffer; its pipeline state is the train state
         plus the device actor's full state — resume must still reproduce
@@ -154,6 +156,7 @@ class TestKillAndResume:
         lrn = Learner(cfg, checkpoint_dir=str(tmp_path / "ck"), actor="fused")
         assert lrn.ckpt_best is None and lrn._best_dir is None
 
+    @pytest.mark.slow   # tier-1 duration audit (ISSUE 6): ~103s on the reference container
     def test_restore_without_pipeline_still_works(self, tmp_path):
         """Weights-only checkpoints (no pipeline entry) restore cleanly."""
         cfg = small_config()
@@ -194,6 +197,7 @@ class TestKillAndResume:
             np.asarray(batch["valid"]), np.ones_like(np.asarray(batch["valid"]))
         )
 
+    @pytest.mark.slow   # tier-1 duration audit (ISSUE 6): ~79s on the reference container
     def test_aligned_periodic_and_final_save(self, tmp_path):
         """A run whose length is a multiple of checkpoint_every must not
         crash at the end-of-run pipeline save (the periodic save already
@@ -210,9 +214,11 @@ class TestKillAndResume:
         restored, reason = b.ckpt.restore_pipeline(b._pipeline_state())
         assert restored is not None and reason == ""
 
-    def test_weights_only_resave_of_existing_step_is_noop(self, tmp_path):
-        """Re-saving an existing step without new (pipeline) content is
-        skipped rather than raising StepAlreadyExistsError."""
+    def test_weights_only_resave_of_existing_step_supersedes(self, tmp_path):
+        """Re-saving an existing step replaces it (never raises
+        StepAlreadyExistsError): a divergence-rollback run legitimately
+        re-reaches old step numbers with NEW content (ISSUE 6), so the
+        newest save always supersedes."""
         cfg = small_config()
         ckdir = str(tmp_path / "ck")
         from dotaclient_tpu.utils.checkpoint import CheckpointManager
@@ -222,10 +228,14 @@ class TestKillAndResume:
         mgr = CheckpointManager(ckdir)
         assert mgr.save(a.state, cfg, force=True)
         mgr.wait()
-        assert mgr.save(a.state, cfg, force=True) is False
+        assert mgr.save(a.state, cfg, force=True)
         mgr.wait()
         assert mgr.latest_step() == int(np.asarray(a.state.step))
+        # the replacement restores clean (fresh integrity manifest too)
+        params, step = mgr.restore_weights()
+        assert step == int(np.asarray(a.state.step))
 
+    @pytest.mark.slow   # tier-1 duration audit (ISSUE 6): ~50s on the reference container
     def test_cross_config_restore_degrades_to_weights_only(self, tmp_path):
         """Restoring a checkpoint into a DIFFERENT game shape (1v1 pipeline
         state into a 5v5 learner — the curriculum-transfer path) must keep
@@ -248,6 +258,7 @@ class TestKillAndResume:
         assert b.device_actor.state.carry[0].shape[0] == L
         b.train(1)                            # and the fused step must run
 
+    @pytest.mark.slow   # tier-1 duration audit (ISSUE 6): ~57s on the reference container
     def test_init_from_seeds_weights_fresh_run(self, tmp_path):
         """init_from seeds params from a SOURCE dir, starts counters and
         optimizer fresh, never writes to the source, and is mutually

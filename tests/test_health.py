@@ -1,0 +1,600 @@
+"""Training health guardian tests (ISSUE 6): the detect → contain →
+recover loop plus checkpoint integrity.
+
+* detect: the in-graph ``health_ok`` probe and its scan AND-fold, the
+  HealthMonitor's latch/EMA-band/generation semantics;
+* contain: the snapshot engine's publish/checkpoint gates;
+* recover: manifest round-trip, corrupt-leaf walk-back, the last_good
+  slot surviving retention GC, divergence rollback in a real learner,
+  and rollback exhaustion exiting loudly with the runbook pointer;
+* admit: the buffer door's staleness counter and non-finite rejection.
+
+The multi-process divergence scenario lives in scripts/chaos_run.py
+(--scenario divergence) and its slow-marked wrapper in test_chaos.py.
+"""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dotaclient_tpu.config import HealthConfig, RunConfig, default_config
+from dotaclient_tpu.train.health import HealthMonitor
+from dotaclient_tpu.utils import faults, telemetry
+
+
+@pytest.fixture()
+def registry():
+    return telemetry.Registry()
+
+
+@pytest.fixture(autouse=True)
+def _no_faults():
+    yield
+    faults.configure(None)
+
+
+def tiny_cfg(**kw):
+    cfg = default_config()
+    cfg = dataclasses.replace(
+        cfg,
+        model=dataclasses.replace(cfg.model, dtype="float32"),
+        ppo=dataclasses.replace(cfg.ppo, rollout_len=4, batch_rollouts=8),
+        env=dataclasses.replace(cfg.env, n_envs=8, max_dota_time=60.0),
+        buffer=dataclasses.replace(
+            cfg.buffer, capacity_rollouts=16, min_fill=8
+        ),
+        log_every=1,
+    )
+    return dataclasses.replace(cfg, **kw)
+
+
+class TestMonitor:
+    def test_healthy_folds_do_not_latch(self, registry):
+        m = HealthMonitor(HealthConfig(), registry)
+        for i in range(10):
+            m.fold_host(i, i, {"loss": 0.1, "grad_norm": 0.5, "health_ok": 1.0})
+        assert m.unhealthy is None
+        assert registry.snapshot()["health/nonfinite_steps_total"] == 0
+
+    def test_nonfinite_latches_and_counts(self, registry):
+        m = HealthMonitor(HealthConfig(), registry)
+        m.fold_host(1, 1, {"loss": 0.1, "grad_norm": 0.5, "health_ok": 1.0})
+        m.fold_host(2, 2, {"loss": float("nan"), "grad_norm": 0.5, "health_ok": 0.0})
+        ev = m.unhealthy
+        assert ev is not None and ev.reason == "nonfinite" and ev.step == 2
+        # the latch holds: later healthy folds do not clear it
+        m.fold_host(3, 3, {"loss": 0.1, "grad_norm": 0.5, "health_ok": 1.0})
+        assert m.unhealthy is ev
+        assert registry.snapshot()["health/nonfinite_steps_total"] == 1
+
+    def test_probe_flag_latches_even_with_finite_scalars(self, registry):
+        """The device-side flag is authoritative: a scanned program whose
+        LAST update looks finite still reports the AND-folded 0."""
+        m = HealthMonitor(HealthConfig(), registry)
+        m.fold_host(1, 1, {"loss": 0.1, "grad_norm": 0.5, "health_ok": 0.0})
+        assert m.unhealthy is not None
+
+    def test_ema_band_catches_explosion(self, registry):
+        cfg = HealthConfig(warmup_steps=5, explosion_band=10.0, ema_alpha=0.5)
+        m = HealthMonitor(cfg, registry)
+        for i in range(6):
+            m.fold_host(i, i, {"loss": 0.1, "grad_norm": 1.0, "health_ok": 1.0})
+        assert m.unhealthy is None
+        m.fold_host(7, 7, {"loss": 0.1, "grad_norm": 50.0, "health_ok": 1.0})
+        ev = m.unhealthy
+        assert ev is not None and ev.reason == "explosion"
+        assert registry.snapshot()["health/ema_breaches_total"] == 1
+
+    def test_ema_band_disarmed_during_warmup(self, registry):
+        cfg = HealthConfig(warmup_steps=50, explosion_band=10.0)
+        m = HealthMonitor(cfg, registry)
+        m.fold_host(0, 0, {"loss": 0.1, "grad_norm": 1.0, "health_ok": 1.0})
+        m.fold_host(1, 1, {"loss": 0.1, "grad_norm": 500.0, "health_ok": 1.0})
+        assert m.unhealthy is None
+
+    def test_clear_discards_stale_generation(self, registry):
+        """Entries submitted before a rollback's clear() are the abandoned
+        timeline's verdicts — folding them afterwards must be a no-op."""
+        m = HealthMonitor(HealthConfig(), registry)
+        m.submit(5, 5, {"loss": jnp.float32(float("nan")),
+                        "grad_norm": jnp.float32(1.0),
+                        "health_ok": jnp.float32(0.0)})
+        stale = m.take_pending()
+        m.clear()
+        m.fold_batch([(g, s, v, jax.device_get(t)) for g, s, v, t in stale])
+        assert m.unhealthy is None   # old-generation entries discarded
+
+    def test_batched_submit_take_fold(self, registry):
+        m = HealthMonitor(HealthConfig(), registry)
+        for i in range(3):
+            m.submit(i, i, {"loss": jnp.float32(0.1),
+                            "grad_norm": jnp.float32(0.5),
+                            "health_ok": jnp.float32(1.0)})
+        pending = m.take_pending()
+        assert len(pending) == 3 and not m.take_pending()
+        m.fold_batch(jax.device_get(pending))
+        assert m.unhealthy is None
+
+
+class TestProbe:
+    def test_fold_scan_metrics_and_folds_health(self):
+        from dotaclient_tpu.train.ppo import fold_scan_metrics
+
+        seq = {
+            "loss": jnp.asarray([1.0, 2.0, 3.0]),
+            "health_ok": jnp.asarray([1.0, 0.0, 1.0]),
+        }
+        out = fold_scan_metrics(seq)
+        assert float(out["loss"]) == 3.0          # last, as ever
+        assert float(out["health_ok"]) == 0.0     # min: one bad taints all
+
+    @pytest.mark.slow   # compiles a full policy train step (~10s+)
+    def test_train_step_probe_flags_nan_batch(self):
+        from dotaclient_tpu.models import init_params, make_policy
+        from dotaclient_tpu.train.ppo import (
+            _train_step, example_batch, init_train_state,
+        )
+
+        cfg = tiny_cfg()
+        policy = make_policy(cfg.model, cfg.obs, cfg.actions)
+        params = init_params(policy, jax.random.PRNGKey(0))
+        state = init_train_state(params, cfg.ppo)
+        batch = example_batch(cfg, batch=cfg.ppo.batch_rollouts)
+        step = jax.jit(lambda s, b: _train_step(policy, cfg.ppo, s, b))
+        _, m = step(state, batch)
+        assert float(m["health_ok"]) == 1.0
+        bad = dict(batch)
+        bad["rewards"] = jnp.asarray(batch["rewards"]).at[0, 0].set(jnp.nan)
+        _, m = step(init_train_state(params, cfg.ppo), bad)
+        assert float(m["health_ok"]) == 0.0
+
+    @pytest.mark.slow   # compiles a full policy train step (~10s+)
+    def test_probe_off_omits_the_metric(self):
+        from dotaclient_tpu.models import init_params, make_policy
+        from dotaclient_tpu.train.ppo import (
+            _train_step, example_batch, init_train_state,
+        )
+
+        cfg = tiny_cfg()
+        policy = make_policy(cfg.model, cfg.obs, cfg.actions)
+        params = init_params(policy, jax.random.PRNGKey(0))
+        state = init_train_state(params, cfg.ppo)
+        batch = example_batch(cfg, batch=cfg.ppo.batch_rollouts)
+        _, m = jax.jit(
+            lambda s, b: _train_step(policy, cfg.ppo, s, b, probe=False)
+        )(state, batch)
+        assert "health_ok" not in m
+
+
+class TestEngineGates:
+    class _Transport:
+        def __init__(self):
+            self.published = []
+
+        def publish_weights(self, msg):
+            self.published.append(msg.version)
+
+    def test_publish_blocked_while_latched_then_flows_after_clear(self, registry):
+        from dotaclient_tpu.train.snapshot import SnapshotEngine
+
+        monitor = HealthMonitor(HealthConfig(), registry)
+        transport = self._Transport()
+        engine = SnapshotEngine(
+            transport=transport, registry=registry, health=monitor
+        )
+        try:
+            params = {"w": np.ones((4,), np.float32)}
+            monitor.fold_host(3, 3, {"loss": float("nan"), "grad_norm": 1.0})
+            engine.submit_publish(params, 3)
+            assert engine.drain(timeout=30.0)
+            assert transport.published == []
+            assert registry.snapshot()["health/publish_blocked_total"] == 1
+            assert engine.last_published == -1
+            monitor.clear()
+            engine.submit_publish(params, 4)
+            assert engine.drain(timeout=30.0)
+            assert transport.published == [4]
+        finally:
+            engine.stop()
+
+    def test_stats_fold_orders_before_publish(self, registry):
+        """A verdict and a publish submitted in the same cycle: the fold
+        runs first, so the poisoned version never reaches the wire even
+        when both jobs are grabbed together."""
+        from dotaclient_tpu.train.snapshot import SnapshotEngine
+
+        monitor = HealthMonitor(HealthConfig(), registry)
+        transport = self._Transport()
+        engine = SnapshotEngine(
+            transport=transport, registry=registry, health=monitor
+        )
+        try:
+            monitor.submit(5, 5, {"loss": np.float32(np.nan),
+                                  "grad_norm": np.float32(1.0),
+                                  "health_ok": np.float32(0.0)})
+            engine.submit_stats(monitor.take_pending(), monitor.fold_batch)
+            engine.submit_publish({"w": np.ones((2,), np.float32)}, 5)
+            assert engine.drain(timeout=30.0)
+            assert transport.published == []
+            assert monitor.unhealthy is not None
+        finally:
+            engine.stop()
+
+
+def _fake_state(step: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    return {
+        "step": np.asarray(step, np.int32),
+        "version": np.asarray(step, np.int32),
+        "params": {"w": rng.normal(size=(8, 8)).astype(np.float32)},
+        "opt_state": {"m": np.zeros((8, 8), np.float32)},
+    }
+
+
+class TestCheckpointIntegrity:
+    @pytest.mark.slow   # orbax save+restore disk round-trip (~10s)
+    def test_manifest_roundtrip_verifies_clean(self, tmp_path, registry, monkeypatch):
+        from dotaclient_tpu.utils.checkpoint import CheckpointManager
+
+        monkeypatch.setattr(telemetry, "get_registry", lambda: registry)
+        cfg = RunConfig()
+        ckpt = CheckpointManager(str(tmp_path / "ck"))
+        assert ckpt.save_host(_fake_state(1, seed=1), cfg)
+        ckpt.wait()
+        assert os.path.exists(ckpt._manifest_path(1))
+        params, step = ckpt.restore_weights()
+        assert step == 1
+        np.testing.assert_array_equal(
+            params["w"], _fake_state(1, seed=1)["params"]["w"]
+        )
+        assert registry.snapshot()["checkpoint/manifest_failures_total"] == 0
+        ckpt.close()
+
+    @pytest.mark.slow   # two saves + walk-back restore (~9s)
+    def test_corrupt_leaf_walks_back_and_counts(self, tmp_path, registry, monkeypatch):
+        """save → corrupt bytes on disk → restore lands on the previous
+        manifest-valid save and counts the failure (ISSUE 6 acceptance)."""
+        from dotaclient_tpu.utils.checkpoint import CheckpointManager
+
+        monkeypatch.setattr(telemetry, "get_registry", lambda: registry)
+        cfg = RunConfig()
+        d = str(tmp_path / "ck")
+        ckpt = CheckpointManager(d)
+        assert ckpt.save_host(_fake_state(1, seed=1), cfg)
+        assert ckpt.save_host(_fake_state(2, seed=2), cfg)
+        ckpt.wait()
+        # corrupt step 2 on disk: overwrite the head of every payload file
+        # (the arrays are tiny, so a single targeted flip can miss them —
+        # bit rot at THIS scale means any byte anywhere)
+        step_dir = os.path.join(d, "2")
+        corrupted = 0
+        for root, _, files in os.walk(step_dir):
+            for name in files:
+                p = os.path.join(root, name)
+                size = os.path.getsize(p)
+                if size == 0:
+                    continue
+                with open(p, "r+b") as f:
+                    f.write(b"\xff" * min(size, 256))
+                corrupted += 1
+        assert corrupted > 0
+        params, step = ckpt.restore_weights()
+        assert step == 1, "restore must walk back to the intact save"
+        np.testing.assert_array_equal(
+            params["w"], _fake_state(1, seed=1)["params"]["w"]
+        )
+        assert registry.snapshot()["checkpoint/manifest_failures_total"] >= 1
+        ckpt.close()
+
+    def test_corrupt_manifest_fault_site(self, tmp_path, registry, monkeypatch):
+        from dotaclient_tpu.utils.checkpoint import CheckpointManager
+
+        monkeypatch.setattr(telemetry, "get_registry", lambda: registry)
+        faults.configure("checkpoint.corrupt_manifest@1")
+        cfg = RunConfig()
+        ckpt = CheckpointManager(str(tmp_path / "ck"))
+        assert ckpt.save_host(_fake_state(1, seed=1), cfg)
+        assert ckpt.save_host(_fake_state(2, seed=2), cfg)
+        ckpt.wait()
+        _, step = ckpt.restore_weights()
+        # the injected verification failure hits the newest step first;
+        # the walk-back lands on the previous one
+        assert step == 1
+        assert registry.snapshot()["checkpoint/manifest_failures_total"] >= 1
+        ckpt.close()
+
+    def test_all_steps_corrupt_raises(self, tmp_path, registry, monkeypatch):
+        from dotaclient_tpu.utils.checkpoint import (
+            CheckpointIntegrityError, CheckpointManager,
+        )
+
+        monkeypatch.setattr(telemetry, "get_registry", lambda: registry)
+        faults.configure("checkpoint.corrupt_manifest@1+1")   # every restore
+        cfg = RunConfig()
+        ckpt = CheckpointManager(str(tmp_path / "ck"))
+        assert ckpt.save_host(_fake_state(1), cfg)
+        ckpt.wait()
+        with pytest.raises(CheckpointIntegrityError):
+            ckpt.restore_weights()
+        ckpt.close()
+
+    @pytest.mark.slow   # several orbax saves + GC (~5s)
+    def test_last_good_slot_survives_retention_gc(self, tmp_path, registry, monkeypatch):
+        """The rolling max_to_keep GC must never eat the health-verified
+        save — the exact failure mode of the ISSUE 6 motivation."""
+        from dotaclient_tpu.utils.checkpoint import CheckpointManager
+
+        monkeypatch.setattr(telemetry, "get_registry", lambda: registry)
+        cfg = RunConfig()
+        ckpt = CheckpointManager(str(tmp_path / "ck"), max_to_keep=2)
+        assert ckpt.save_host(_fake_state(1, seed=1), cfg, mark_good=True)
+        for s in range(2, 6):
+            assert ckpt.save_host(_fake_state(s, seed=s), cfg)
+        ckpt.wait()
+        assert 1 not in ckpt._mgr.all_steps()   # GC'd from the main ring
+        assert ckpt.last_good_step() == 1       # but the slot still has it
+        restored = ckpt.restore_last_good(cfg, _abstract_from(_fake_state(1)))
+        assert restored is not None
+        state, _ = restored
+        np.testing.assert_array_equal(
+            np.asarray(state.params["w"]),
+            _fake_state(1, seed=1)["params"]["w"],
+        )
+        assert registry.snapshot()["health/last_good_step"] == 1.0
+        ckpt.close()
+
+    def test_same_step_resave_supersedes(self, tmp_path, registry, monkeypatch):
+        """A rollback-then-retrain run re-reaches old step numbers; the
+        fresh save must replace the stale one, not be declined."""
+        from dotaclient_tpu.utils.checkpoint import CheckpointManager
+
+        monkeypatch.setattr(telemetry, "get_registry", lambda: registry)
+        cfg = RunConfig()
+        ckpt = CheckpointManager(str(tmp_path / "ck"))
+        assert ckpt.save_host(_fake_state(3, seed=1), cfg)
+        assert ckpt.save_host(_fake_state(3, seed=9), cfg)
+        ckpt.wait()
+        params, _ = ckpt.restore_weights()
+        np.testing.assert_array_equal(
+            params["w"], _fake_state(3, seed=9)["params"]["w"]
+        )
+        ckpt.close()
+
+    def test_discard_steps_above(self, tmp_path, registry, monkeypatch):
+        from dotaclient_tpu.utils.checkpoint import CheckpointManager
+
+        monkeypatch.setattr(telemetry, "get_registry", lambda: registry)
+        cfg = RunConfig()
+        ckpt = CheckpointManager(str(tmp_path / "ck"))
+        for s in (1, 2, 3):
+            assert ckpt.save_host(_fake_state(s), cfg)
+        ckpt.wait()
+        assert ckpt.discard_steps_above(1) == 2
+        assert ckpt.latest_step() == 1
+        assert not os.path.exists(ckpt._manifest_path(3))
+        ckpt.close()
+
+
+def _abstract_from(fake):
+    """A TrainState-shaped template matching the _fake_state layout."""
+    from dotaclient_tpu.train.ppo import TrainState
+
+    return TrainState(
+        step=jnp.asarray(fake["step"]),
+        version=jnp.asarray(fake["version"]),
+        params=jax.tree.map(jnp.asarray, fake["params"]),
+        opt_state=jax.tree.map(jnp.asarray, fake["opt_state"]),
+    )
+
+
+class TestAdmissionControl:
+    def _buffer(self, cfg):
+        from dotaclient_tpu.buffer import TrajectoryBuffer
+        from dotaclient_tpu.parallel import make_mesh
+
+        return TrajectoryBuffer(cfg, make_mesh(cfg.mesh, devices=jax.devices()[:1]))
+
+    def _rollout(self, cfg, version=0, poison=False):
+        from dotaclient_tpu.train import example_batch
+
+        row = jax.tree.map(
+            lambda x: np.asarray(x[0]).copy(), example_batch(cfg, batch=1)
+        )
+        if poison:
+            row["rewards"][0] = np.nan
+        return ({"model_version": version}, row)
+
+    def test_nonfinite_payload_rejected_and_counted(self, monkeypatch, registry):
+        monkeypatch.setattr(telemetry, "get_registry", lambda: registry)
+        cfg = tiny_cfg()
+        buf = self._buffer(cfg)
+        kept = buf.add(
+            [self._rollout(cfg), self._rollout(cfg, poison=True)],
+            current_version=0,
+        )
+        assert kept == 1
+        assert buf.dropped_nonfinite == 1
+        assert registry.snapshot()["buffer/nonfinite_rejected_total"] == 1
+
+    def test_nonfinite_admitted_when_disabled(self, monkeypatch, registry):
+        monkeypatch.setattr(telemetry, "get_registry", lambda: registry)
+        cfg = tiny_cfg()
+        cfg = dataclasses.replace(
+            cfg, buffer=dataclasses.replace(cfg.buffer, reject_nonfinite=False)
+        )
+        buf = self._buffer(cfg)
+        assert buf.add([self._rollout(cfg, poison=True)], current_version=0) == 1
+
+    def test_stale_rejection_counted(self, monkeypatch, registry):
+        monkeypatch.setattr(telemetry, "get_registry", lambda: registry)
+        cfg = tiny_cfg()
+        cfg = dataclasses.replace(
+            cfg, buffer=dataclasses.replace(cfg.buffer, max_weight_staleness=2)
+        )
+        buf = self._buffer(cfg)
+        assert buf.add([self._rollout(cfg, version=0)], current_version=10) == 0
+        assert registry.snapshot()["buffer/stale_rejected_total"] == 1
+        assert buf.add([self._rollout(cfg, version=9)], current_version=10) == 1
+
+    def test_drop_newer_than_purges_poisoned_window(self, monkeypatch, registry):
+        monkeypatch.setattr(telemetry, "get_registry", lambda: registry)
+        cfg = tiny_cfg()
+        buf = self._buffer(cfg)
+        # versions all within the ingest staleness window of 6
+        buf.add([self._rollout(cfg, version=v) for v in (2, 3, 5, 6)],
+                current_version=6)
+        assert buf.size == 4
+        assert buf.drop_newer_than(3) == 2
+        assert buf.size == 2
+        assert registry.snapshot()["buffer/poison_dropped_total"] == 2
+
+    @pytest.mark.slow   # vec pool rollout compile (~9s)
+    def test_actor_nonfinite_fault_site_rejected_at_the_door(self, monkeypatch, registry):
+        """The chaos path end to end in-process: a vec pool with the
+        actor.nonfinite_payload fault ships one poisoned rollout; the
+        buffer door rejects exactly it."""
+        monkeypatch.setattr(telemetry, "get_registry", lambda: registry)
+        from dotaclient_tpu.models import init_params, make_policy
+
+        faults.configure("actor.nonfinite_payload@1")
+        cfg = tiny_cfg()
+        from dotaclient_tpu.actor import VecActorPool
+
+        policy = make_policy(cfg.model, cfg.obs, cfg.actions)
+        params = init_params(policy, jax.random.PRNGKey(0))
+        shipped = []
+        pool = VecActorPool(
+            cfg, policy, params, seed=0, rollout_sink=shipped.extend
+        )
+        pool.run(cfg.ppo.rollout_len, refresh_every=0)
+        assert shipped, "pool shipped nothing"
+        buf = self._buffer(cfg)
+        kept = buf.add(list(shipped), current_version=0)
+        assert buf.dropped_nonfinite == 1
+        assert kept == len(shipped) - 1
+
+
+class TestSchemaTier:
+    def test_health_keys_required_when_flagged(self):
+        import importlib.util
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "check_telemetry_schema",
+            os.path.join(root, "scripts", "check_telemetry_schema.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        line = (
+            '{"ts": 1.0, "step": 1, "scalars": {'
+            + ", ".join(f'"{k}": 0.0' for k in mod.REQUIRED_KEYS)
+            + "}}"
+        )
+        errors = mod.validate_lines([line], extra_required=mod.HEALTH_KEYS)
+        joined = "\n".join(errors)
+        for key in mod.HEALTH_KEYS:
+            assert key in joined
+        # the clean line needs every timer's full leaf set too (the span
+        # completeness rule), not just the /mean_s spot checks
+        keys = set(mod.REQUIRED_KEYS) | set(mod.HEALTH_KEYS)
+        for k in mod.REQUIRED_KEYS:
+            if k.startswith("span/"):
+                root = k.rsplit("/", 1)[0]
+                keys.update(f"{root}/{leaf}" for leaf in mod.TIMER_LEAVES)
+        ok_line = (
+            '{"ts": 1.0, "step": 1, "scalars": {'
+            + ", ".join(f'"{k}": 0.0' for k in sorted(keys))
+            + "}}"
+        )
+        assert not mod.validate_lines([ok_line], extra_required=mod.HEALTH_KEYS)
+
+
+class TestLearnerRollback:
+    @pytest.mark.slow
+    def test_divergence_rolls_back_and_completes(self, tmp_path):
+        """In-process acceptance: injected NaN gradient → probe flags it,
+        publishes/checkpoints block, rollback restores last_good, the run
+        completes to its exact target step with finite loss and a
+        monotone version counter."""
+        from dotaclient_tpu.train.learner import Learner
+
+        faults.configure("learner.nan_grad@5")
+        try:
+            learner = Learner(
+                tiny_cfg(checkpoint_every=2), actor="device",
+                checkpoint_dir=str(tmp_path / "ck"),
+            )
+            out = learner.train(10)
+            snap = telemetry.get_registry().snapshot()
+            assert snap["health/rollbacks_total"] >= 1
+            assert snap["health/nonfinite_steps_total"] >= 1
+            assert np.isfinite(out["loss"])
+            assert learner._host_step == 10
+            assert learner.ckpt.latest_step() == 10
+            assert learner.ckpt.last_good_step() == 10
+            # version counter stayed monotone across the rollback: the
+            # poisoned version range is never reused on the wire
+            assert learner._host_version > 10
+            assert int(np.asarray(learner.state.version)) == learner._host_version
+        finally:
+            if learner._snap_engine is not None:
+                learner._snap_engine.stop()
+            learner.ckpt.wait()
+            learner.ckpt.close()
+
+    @pytest.mark.slow
+    def test_rollback_exhaustion_exits_loudly_with_runbook(self, tmp_path):
+        """A divergence that persists through every retry must raise (the
+        CLI then exits non-zero) and point at the runbook."""
+        from dotaclient_tpu.train.learner import Learner
+
+        # every batch from the 5th on is poisoned: each rollback's retry
+        # diverges again until max_rollbacks is exhausted
+        faults.configure("learner.nan_grad@5+1")
+        learner = Learner(
+            tiny_cfg(
+                checkpoint_every=2,
+                health=HealthConfig(max_rollbacks=1),
+            ),
+            actor="device", checkpoint_dir=str(tmp_path / "ck"),
+        )
+        try:
+            with pytest.raises(RuntimeError, match="OPERATIONS.md"):
+                learner.train(12)
+            assert (
+                telemetry.get_registry().snapshot()["health/rollbacks_total"]
+                >= 1
+            )
+        finally:
+            if learner._snap_engine is not None:
+                learner._snap_engine.stop()
+            learner.ckpt.wait()
+            learner.ckpt.close()
+
+    @pytest.mark.slow
+    def test_no_checkpoint_dir_degrades_to_containment(self):
+        """Without a restore point the guardian must not crash the run:
+        training continues (NaN and all), publishes stay blocked, the
+        operator is warned."""
+        from dotaclient_tpu.train.learner import Learner
+
+        faults.configure("learner.nan_grad@3")
+        # the registry is process-global: other rollback tests in the same
+        # session may already have counted — assert the DELTA stays zero
+        before = telemetry.get_registry().snapshot().get(
+            "health/rollbacks_total", 0.0
+        )
+        learner = Learner(tiny_cfg(), actor="device")
+        try:
+            out = learner.train(6)
+            assert out["optimizer_steps"] == 6.0
+            assert learner._health.unhealthy is not None
+            snap = telemetry.get_registry().snapshot()
+            assert snap["health/rollbacks_total"] == before
+        finally:
+            if learner._snap_engine is not None:
+                learner._snap_engine.stop()

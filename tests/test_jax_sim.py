@@ -297,6 +297,7 @@ class TestDeviceRollout:
         assert chunk["carry0"][0].shape == (L, cfg.model.hidden_dim)
         assert set(chunk["actions"]) == set(cfg.actions.head_sizes)
 
+    @pytest.mark.slow   # tier-1 duration audit (ISSUE 6): ~38s on the reference container
     def test_feeds_train_step_and_buffer(self):
         from dotaclient_tpu.buffer import TrajectoryBuffer
         from dotaclient_tpu.parallel import make_mesh
@@ -342,6 +343,7 @@ class TestDeviceRollout:
         chunk, _ = da.collect(params, opp_params=params)
         assert chunk["rewards"].shape[0] == cfg.env.n_envs
 
+    @pytest.mark.slow   # tier-1 duration audit (ISSUE 6): ~102s on the reference container
     def test_learner_device_mode(self):
         from dotaclient_tpu.train.learner import Learner
 

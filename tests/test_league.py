@@ -302,6 +302,7 @@ class TestLeagueAnchors:
 
 
 class TestEvalCli:
+    @pytest.mark.slow   # tier-1 duration audit (ISSUE 6): ~92s on the reference container
     def test_eval_from_checkpoint_and_vs_checkpoint(self, tmp_path, capsys):
         """`python -m dotaclient_tpu.league`: restore a run's checkpoint by
         its OWN stored config and play eval games — the reference's
@@ -332,6 +333,7 @@ class TestEvalCli:
 
 
 class TestLearnerLeagueWiring:
+    @pytest.mark.slow   # tier-1 duration audit (ISSUE 6): ~90s on the reference container
     def test_device_league_trains_and_snapshots(self):
         from dotaclient_tpu.train.learner import Learner
 

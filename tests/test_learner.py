@@ -60,6 +60,7 @@ class TestMinibatchEpochs:
         # frames count unique experience: one batch consumed
         assert stats["frames_trained"] == 16 * 8
 
+    @pytest.mark.slow   # tier-1 duration audit (ISSUE 6): ~110s on the reference container
     def test_minibatch_resume_reproduces_metrics(self, tmp_path):
         """The shuffle-stream position is checkpointed: a resumed learner
         replays the SAME upcoming permutations as the original's
@@ -110,6 +111,7 @@ class TestFusedEpochStep:
             ),
         )
 
+    @pytest.mark.slow   # tier-1 duration audit (ISSUE 6): ~38s on the reference container
     def test_one_dispatch_per_batch(self):
         """The acceptance contract: with minibatches > 1, one consumed
         batch issues exactly ONE donated dispatch (the fused epoch step) —
@@ -127,6 +129,7 @@ class TestFusedEpochStep:
         learner.train(4)   # one consumed batch = 2 epochs × 2 minibatches
         assert calls == {"epoch": 1, "staged": 0, "gather": 0}
 
+    @pytest.mark.slow   # tier-1 duration audit (ISSUE 6): ~46s on the reference container
     def test_fused_epoch_off_uses_staged_path(self):
         learner = Learner(self.multi_cfg(fused=False), actor="device")
         assert learner.epoch_step is None
@@ -134,6 +137,7 @@ class TestFusedEpochStep:
         assert stats["optimizer_steps"] == 4
         assert int(learner.state.step) == 4
 
+    @pytest.mark.slow   # tier-1 duration audit (ISSUE 6): ~64s on the reference container
     def test_fused_matches_staged_in_learner(self):
         """End-to-end parity: identical seeds and experience, fused epoch
         vs staged loop — same permutation stream, same final params (to
@@ -169,6 +173,7 @@ class TestPrefetchLane:
             ),
         )
 
+    @pytest.mark.slow   # tier-1 duration audit (ISSUE 6): ~66s on the reference container
     def test_prefetch_hits_and_gauges(self):
         learner = Learner(self.surplus_cfg(), actor="device")
         learner.train(6)
@@ -202,6 +207,7 @@ class TestPrefetchLane:
         again = learner.buffer.take(current_version=learner._host_version)
         np.testing.assert_array_equal(staged, np.asarray(again["rewards"]))
 
+    @pytest.mark.slow   # tier-1 duration audit (ISSUE 6): ~49s on the reference container
     def test_pipeline_checkpoint_includes_flushed_prefetch(self, tmp_path):
         """_pipeline_state folds an in-flight prefetched batch back into
         the buffer snapshot — a restore sees every unconsumed rollout."""
@@ -260,6 +266,7 @@ class TestCheckpoint:
         )
         mgr.close()
 
+    @pytest.mark.slow   # tier-1 duration audit (ISSUE 6): ~42s on the reference container
     def test_learner_resume_continues_step_count(self, tmp_path):
         ckpt_dir = str(tmp_path / "ckpt")
         cfg = tiny_config()

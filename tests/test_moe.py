@@ -124,6 +124,7 @@ class TestMoEMLP:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.slow   # tier-1 duration audit (ISSUE 6): ~36s on the reference container
     def test_moe_transformer_trains_on_data_model_mesh(self):
         from dotaclient_tpu.models import init_params, make_policy
         from dotaclient_tpu.train.ppo import (

@@ -166,6 +166,7 @@ class TestPipelineParallel:
 
 
 class TestTensorParallelEquivalence:
+    @pytest.mark.slow   # tier-1 duration audit (ISSUE 6): ~58s on the reference container
     def test_wide_core_tp2_matches_single_device(self):
         """hidden=512 policy, one train step: (1 data, 2 model) mesh output
         must match the 1-device run (same math, different layout)."""

@@ -5,6 +5,7 @@ import os
 
 import jax
 import jax.numpy as jnp
+import pytest
 
 from dotaclient_tpu.utils.profiling import trace
 
@@ -15,6 +16,7 @@ class TestTrace:
             x = jax.jit(lambda a: a * 2)(jnp.ones((4,)))
         assert float(x.sum()) == 8.0
 
+    @pytest.mark.slow   # tier-1 duration audit (ISSUE 6): ~59s on the reference container
     def test_writes_profile_artifacts(self, tmp_path):
         logdir = str(tmp_path / "prof")
         with trace(logdir):

@@ -180,6 +180,7 @@ class TestSinks:
 
 
 class TestLearnerTelemetry:
+    @pytest.mark.slow   # tier-1 duration audit (ISSUE 6): ~40s on the reference container
     def test_smoke_run_emits_pipeline_gauges_and_spans(self, tmp_path):
         """The acceptance contract: a tiny run's drained scalars carry the
         staleness/queue-depth/occupancy gauges, and the JSONL record carries
@@ -220,6 +221,7 @@ class TestLearnerTelemetry:
         # dispatch timings are real (the train step ran)
         assert union["span/learner/dispatch/count"] >= 2
 
+    @pytest.mark.slow   # tier-1 duration audit (ISSUE 6): ~175s on the reference container
     def test_no_added_device_syncs_in_train_loop(self, monkeypatch):
         """Telemetry must not break the sync discipline: with no log
         boundary in range, the number of device fetches is INDEPENDENT of
@@ -248,6 +250,7 @@ class TestLearnerTelemetry:
             f"something inside the train loop is syncing"
         )
 
+    @pytest.mark.slow   # tier-1 duration audit (ISSUE 6): ~143s on the reference container
     def test_fetches_only_at_log_boundaries(self, monkeypatch):
         """With log_every=1 every step is a boundary: fetch count grows by
         exactly the per-boundary cost, pinning fetches TO the boundaries.
@@ -429,6 +432,7 @@ class TestSchemaChecker:
             srv.close()
             shm.close()
 
+    @pytest.mark.slow   # tier-1 duration audit (ISSUE 6): ~62s on the reference container
     def test_smoke_run_passes_schema(self, checker, capsys):
         """The CI guard end-to-end: a --smoke learner run with the JSONL
         sink validates cleanly against the documented schema (tier-1

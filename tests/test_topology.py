@@ -330,6 +330,7 @@ class TestAmqpTransportContract:
 
 
 class TestMultiProcessTopology:
+    @pytest.mark.slow   # tier-1 duration audit (ISSUE 6): ~69s on the reference container
     def test_learner_survives_actor_kill(self):
         """Two standalone actor processes feed a socket-transport learner;
         one is SIGKILLed mid-run; the learner still reaches its step target

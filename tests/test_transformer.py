@@ -130,6 +130,7 @@ class TestTransformerCore:
 
 
 class TestTransformerTraining:
+    @pytest.mark.slow   # tier-1 duration audit (ISSUE 6): ~61s on the reference container
     def test_device_actor_and_train_step(self):
         """core="transformer" trains end-to-end on the smoke config
         (VERDICT round 1 item 7's bar)."""
