@@ -354,6 +354,148 @@ def bench_health(config) -> dict:
     return out
 
 
+def bench_quantize(config) -> dict:
+    """Quantize stage (ISSUE 7): the rollout experience plane, narrow vs f32.
+
+    Three measurements, narrow (``rollout_wire_dtype=bfloat16``) against
+    full-width f32:
+
+    * **wire bytes per frame** — one benchmark-config chunk through
+      ``encode_rollout_bytes`` both ways; the headline
+      ``rollout_compression`` is the byte ratio (≥1.8× required: obs
+      dominate chunk bytes and halve exactly, pinned f32 leaves and proto
+      framing are the remainder).
+    * **ingest→consume throughput** — decode → ``buffer.add`` (narrow
+      staging + scatter) → ``buffer.take`` (on-device upcast gather),
+      frames/sec, best-of-3 interleaved trials (the same best-of rule as
+      the transport stage — this host's memory bandwidth swings on a
+      seconds scale, so capability is the metric).
+    * **optimizer frames/sec through the consume path** — take(hold) →
+      donated train step → requeue, so every step pays the narrow ring's
+      gather+upcast; the acceptance bar is the narrow path within 2% of
+      f32 (the upcast is two fused casts inside an already-jitted gather).
+      The train step itself is compiled ONCE and shared — ``take()`` hands
+      it identical f32 batches in both modes by contract.
+    """
+    import dataclasses
+
+    from dotaclient_tpu.buffer.trajectory_buffer import TrajectoryBuffer
+    from dotaclient_tpu.models import init_params, make_policy
+    from dotaclient_tpu.parallel import make_mesh
+    from dotaclient_tpu.train import (
+        example_batch,
+        init_train_state,
+        make_train_step,
+    )
+    from dotaclient_tpu.transport.serialize import (
+        decode_rollout_bytes,
+        encode_rollout_bytes,
+        rollout_wire_kwargs,
+    )
+
+    B, T = config.ppo.batch_rollouts, config.ppo.rollout_len
+    row = jax.tree.map(lambda x: np.asarray(x[0]), example_batch(config, batch=1))
+    cfgs = {
+        "f32": config,
+        "bf16": dataclasses.replace(
+            config,
+            transport=dataclasses.replace(
+                config.transport, rollout_wire_dtype="bfloat16"
+            ),
+        ),
+    }
+    wire_kwargs = {k: rollout_wire_kwargs(cfg) for k, cfg in cfgs.items()}
+    frames = {
+        label: bytes(encode_rollout_bytes(row, 0, 0, 0, T, 0.0, **kw))
+        for label, kw in wire_kwargs.items()
+    }
+    out: dict = {
+        "wire_bytes_per_frame_f32": len(frames["f32"]),
+        "wire_bytes_per_frame_bf16": len(frames["bf16"]),
+        "rollout_compression": (
+            round(len(frames["f32"]) / len(frames["bf16"]), 2)
+            if frames["bf16"]
+            else 0.0
+        ),
+    }
+
+    mesh = make_mesh(config.mesh)
+    buffers = {k: TrajectoryBuffer(cfg, mesh) for k, cfg in cfgs.items()}
+
+    def ingest_consume(label: str, n_batches: int) -> float:
+        buf = buffers[label]
+        payload = frames[label]
+        t0 = time.perf_counter()
+        for _ in range(n_batches):
+            decoded = []
+            for i in range(B):
+                meta, arrays = decode_rollout_bytes(payload)
+                meta["rollout_id"] = i
+                decoded.append((meta, arrays))
+            buf.add(decoded, current_version=0)
+            batch = buf.take(batch_size=B)
+            assert batch is not None
+        jax.block_until_ready(jax.tree.leaves(batch)[0])
+        return n_batches * B / (time.perf_counter() - t0)
+
+    n_batches = 6
+    ingest_fps = {"f32": 0.0, "bf16": 0.0}
+    ingest_consume("f32", 2)   # warmup: compiles scatter/gather both widths
+    ingest_consume("bf16", 2)
+    for _ in range(3):         # interleaved: noise hits both modes
+        for label in ("f32", "bf16"):
+            ingest_fps[label] = max(
+                ingest_fps[label], ingest_consume(label, n_batches)
+            )
+    out["ingest_consume_fps_f32"] = round(ingest_fps["f32"], 1)
+    out["ingest_consume_fps_bf16"] = round(ingest_fps["bf16"], 1)
+
+    # -- optimizer frames/s through the consume path -------------------------
+    policy = make_policy(config.model, config.obs, config.actions)
+    step = make_train_step(policy, config, mesh)
+    states = {
+        k: init_train_state(init_params(policy, jax.random.PRNGKey(0)), config.ppo)
+        for k in cfgs
+    }
+    # refill: ingest_consume's takes freed their slots — park one batch's
+    # worth of rollouts in each ring for the take/requeue loop to re-gather
+    for label in ("f32", "bf16"):
+        decoded = []
+        for i in range(B):
+            meta, arrays = decode_rollout_bytes(frames[label])
+            meta["rollout_id"] = i
+            decoded.append((meta, arrays))
+        buffers[label].add(decoded, current_version=0)
+
+    def optimizer_loop(label: str, n_steps: int) -> float:
+        buf = buffers[label]
+        state = states[label]
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            batch, ticket = buf.take(batch_size=B, hold=True)
+            state, metrics = step(state, batch)
+            buf.requeue(ticket)   # same rows re-gather next step
+        jax.block_until_ready(metrics["loss"])
+        states[label] = state
+        return n_steps * B * T / (time.perf_counter() - t0)
+
+    opt_fps = {"f32": 0.0, "bf16": 0.0}
+    optimizer_loop("f32", 3)   # compile + settle
+    optimizer_loop("bf16", 3)
+    n_steps = 60
+    for _ in range(2):
+        for label in ("f32", "bf16"):
+            opt_fps[label] = max(
+                opt_fps[label], optimizer_loop(label, n_steps)
+            )
+    out["optimizer_fps_f32"] = round(opt_fps["f32"], 1)
+    out["optimizer_fps_bf16"] = round(opt_fps["bf16"], 1)
+    out["optimizer_ratio"] = (
+        round(opt_fps["bf16"] / opt_fps["f32"], 4) if opt_fps["f32"] else 0.0
+    )
+    return out
+
+
 def main() -> None:
     from dotaclient_tpu.config import default_config
     from dotaclient_tpu.models import init_params, make_policy
@@ -535,6 +677,16 @@ def main() -> None:
     except Exception as e:
         health = {"error": f"{type(e).__name__}: {e}"}
 
+    # -- quantize stage: narrow-dtype experience plane (ISSUE 7) -------------
+    try:
+        quantize = bench_quantize(config)
+        # acceptance: wire bytes/frame reduced ≥1.8× with bf16 rollouts,
+        # optimizer frames/s through the narrow consume path within 2% of f32
+        stages["rollout_compression"] = quantize.get("rollout_compression", 0.0)
+        stages["quantize_optimizer_ratio"] = quantize.get("optimizer_ratio", 0.0)
+    except Exception as e:
+        quantize = {"error": f"{type(e).__name__}: {e}"}
+
     anchor = None
     if os.path.exists(ANCHOR_PATH):
         try:
@@ -569,6 +721,7 @@ def main() -> None:
                 "transport": transport,
                 "stall": stall,
                 "health": health,
+                "quantize": quantize,
                 "telemetry_jsonl": telemetry_path,
             }
         )

@@ -120,6 +120,12 @@ def main(argv=None) -> int:
                    help="chunk length T; MUST match the learner's "
                         "ppo.rollout_len (e.g. 8 for a --smoke learner) — "
                         "skewed chunks are dropped at the learner's buffer")
+    p.add_argument("--rollout-wire-dtype", type=str, default=None,
+                   choices=("float32", "bfloat16"),
+                   help="narrow rollout payloads on the wire (overrides "
+                        "transport.rollout_wire_dtype); set the SAME value "
+                        "as the learner — bfloat16 roughly halves shipped "
+                        "bytes, precision-critical leaves stay f32")
     p.add_argument("--seed", type=int, default=None,
                    help="rollout RNG seed; default derives from $POD_NAME "
                         "(unique per k8s replica) or 0 outside k8s")
@@ -195,6 +201,13 @@ def main(argv=None) -> int:
         config = dataclasses.replace(
             config, ppo=dataclasses.replace(
                 config.ppo, rollout_len=args.rollout_len
+            )
+        )
+    if args.rollout_wire_dtype is not None:
+        config = dataclasses.replace(
+            config, transport=dataclasses.replace(
+                config.transport,
+                rollout_wire_dtype=args.rollout_wire_dtype,
             )
         )
 

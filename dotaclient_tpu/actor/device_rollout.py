@@ -141,10 +141,50 @@ class DeviceActor:
             ep_return=jnp.zeros((self.n_lanes,), jnp.float32),
             stats=self._zero_stats(),
         )
+        # Quantized experience plane (ISSUE 7): chunks bound for the
+        # trajectory buffer narrow to the wire dtypes INSIDE the jitted
+        # collect program (obs→bf16, bounded int leaves→int8; pinned
+        # leaves stay f32), so ``add_device`` scatters narrow rows into
+        # the narrow ring with no extra dispatch. Fused mode calls
+        # ``_rollout_impl`` directly and keeps full width — it trains on
+        # the chunk in the same program and never stores it, so
+        # quantizing there would cost precision for zero resident bytes.
+        self._chunk_cast: Dict[str, Any] = {}
+        if config.transport.rollout_wire_dtype != "float32":
+            from dotaclient_tpu.train.ppo import example_batch
+            from dotaclient_tpu.transport.serialize import (
+                flatten_tree,
+                rollout_cast_plan,
+                rollout_int_bounds,
+            )
+
+            flat = flatten_tree(example_batch(config, batch=1))
+            self._chunk_cast = rollout_cast_plan(
+                {n: np.dtype(a.dtype) for n, a in flat.items()},
+                config.transport.rollout_wire_dtype,
+                rollout_int_bounds(config),
+            )
+
+        def _collect_impl(params, state, opp_params):
+            new_state, chunk, stats = self._rollout_impl(
+                params, state, opp_params
+            )
+            if self._chunk_cast:
+                from dotaclient_tpu.transport.serialize import (
+                    apply_cast_plan,
+                    flatten_tree,
+                    unflatten_tree,
+                )
+
+                chunk = unflatten_tree(
+                    apply_cast_plan(flatten_tree(chunk), self._chunk_cast)
+                )
+            return new_state, chunk, stats
+
         # No donation: the state is small (the big arrays are the chunk
         # OUTPUTS), and zero-initialized carries can alias the same cached
         # constant buffer, which donation would flag as a double-donate.
-        self._rollout = jax.jit(self._rollout_impl)
+        self._rollout = jax.jit(_collect_impl)
         # host-side counters, updated from fetched stats at log boundaries
         self.env_steps = 0
         self.rollouts_shipped = 0

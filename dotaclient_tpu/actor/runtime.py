@@ -175,6 +175,12 @@ class ActorPool(WindowedStatsMixin):
             self._begin_chunk(lane, (zeros_row, zeros_row))
 
         self._step_fn = jax.jit(self._device_step)
+        # Rollout wire narrowing (ISSUE 7): encode kwargs derived once from
+        # config, applied when chunks leave through a transport (the
+        # in-proc rollout_sink keeps full-width protos for gRPC parity).
+        from dotaclient_tpu.transport.serialize import rollout_wire_kwargs
+
+        self._wire_kwargs = rollout_wire_kwargs(config)
         # throughput counters
         self.env_steps = 0
         self.rollouts_shipped = 0
@@ -432,8 +438,7 @@ class ActorPool(WindowedStatsMixin):
             "valid": np.asarray(valid, np.float32),
             "carry0": (lane.carry0[0], lane.carry0[1]),
         }
-        rollout = encode_rollout(
-            arrays,
+        meta = dict(
             model_version=lane.version0,
             env_id=lane.env_idx,
             rollout_id=self._next_rollout_id,
@@ -442,9 +447,13 @@ class ActorPool(WindowedStatsMixin):
         )
         self._next_rollout_id += 1
         if self.rollout_sink is not None:
-            self.rollout_sink(rollout)
+            # in-proc consumers get full-width protos (gRPC-parity path —
+            # no wire to save bytes on)
+            self.rollout_sink(encode_rollout(arrays, **meta))
         elif self.transport is not None:
-            self.transport.publish_rollout(rollout)
+            self.transport.publish_rollout(
+                encode_rollout(arrays, **meta, **self._wire_kwargs)
+            )
         self.rollouts_shipped += 1
         self._tel.counter("actor/rollouts_shipped").inc()
         self._tel.counter("actor/frames_shipped").inc(n)

@@ -169,6 +169,13 @@ class VecActorPool(WindowedStatsMixin):
         self.wins = 0
         self._tel = telemetry.get_registry()
         self._faults = faults.get()   # None unless chaos injection is on
+        # Rollout wire narrowing (ISSUE 7): encode-time kwargs derived once
+        # from config. In-proc delivery (rollout_sink) ships full-width
+        # decoded arrays; the learner's buffer quantizes at its own door
+        # per its config.
+        from dotaclient_tpu.transport.serialize import rollout_wire_kwargs
+
+        self._wire_kwargs = rollout_wire_kwargs(config)
         # Every distinct weight version this pool has ever APPLIED — the
         # chaos harness's evidence that no poisoned (health-blocked)
         # version reached an actor (scripts/chaos_run.py divergence
@@ -372,10 +379,14 @@ class VecActorPool(WindowedStatsMixin):
             )
             for meta, arrays in out:
                 if publish_bytes is not None:
-                    publish_bytes(encode_rollout_bytes(arrays, **meta))
+                    publish_bytes(
+                        encode_rollout_bytes(
+                            arrays, **meta, **self._wire_kwargs
+                        )
+                    )
                 else:
                     self.transport.publish_rollout(
-                        encode_rollout(arrays, **meta)
+                        encode_rollout(arrays, **meta, **self._wire_kwargs)
                     )
         self.rollouts_shipped += len(out)
 

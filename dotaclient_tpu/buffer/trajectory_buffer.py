@@ -38,6 +38,14 @@ def _pow2ceil(n: int) -> int:
     return 1 << (max(1, n) - 1).bit_length()
 
 
+try:  # the narrow wire dtype the admission scan must treat as float
+    import ml_dtypes
+
+    _WIRE_BF16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    _WIRE_BF16 = np.dtype(np.void)   # matches no real leaf
+
+
 class TrajectoryBuffer:
     """FIFO ring of rollout chunks in device memory.
 
@@ -99,6 +107,83 @@ class TrajectoryBuffer:
         self._tel.counter("buffer/poison_dropped_total")
         self._sharding = data_sharding(mesh, config.mesh)
         template = example_batch(config, batch=cap)
+        # Quantized experience plane (ISSUE 7): with
+        # transport.rollout_wire_dtype narrow, the ring STORES the wire
+        # dtypes — ≈half the resident HBM bytes and per-scatter H2D traffic
+        # — and the upcast to the train dtypes happens on-device inside the
+        # already-jitted consume gather, so `take()` hands the train step
+        # f32 inputs bit-identical to decoding the wire (bf16→f32 and
+        # int8→int32 are exact). The f32 template's dtypes are kept as the
+        # consume-time upcast targets; the narrow template drives the
+        # staging lanes, the skew check, and the scatter.
+        from dotaclient_tpu.transport.serialize import (
+            apply_cast_plan,
+            flatten_tree,
+            rollout_cast_plan,
+            rollout_int_bounds,
+            unflatten_tree,
+        )
+
+        self._consume_dtypes = jax.tree.map(
+            lambda x: np.dtype(x.dtype), template
+        )
+        wire_dtype = config.transport.rollout_wire_dtype
+        flat_tmpl = flatten_tree(template)
+        int_bounds = rollout_int_bounds(config)
+        self._wire_plan = rollout_cast_plan(
+            {n: np.dtype(a.dtype) for n, a in flat_tmpl.items()},
+            wire_dtype,
+            int_bounds,
+        )
+        # Per-leaf admission dtypes: the stored dtype plus every width the
+        # same leaf may legitimately arrive at — the original full width
+        # (an in-proc actor or an f32-knob fleet member) and the narrow
+        # wire width (a bf16-knob actor shipping to an f32 learner). The
+        # staging copy casts on assignment either way; genuinely skewed
+        # dtypes (wrong kind/meaning) still drop at the door.
+        accept_flat: Dict[str, frozenset] = {}
+        if self._wire_plan:   # a narrow config's plan IS the bf16 plan
+            alt_plan = self._wire_plan
+        else:
+            try:
+                alt_plan = rollout_cast_plan(
+                    {n: np.dtype(a.dtype) for n, a in flat_tmpl.items()},
+                    "bfloat16",
+                    int_bounds,
+                )
+            except ValueError:   # ml_dtypes unavailable: full-width only
+                alt_plan = {}
+        for n, a in flat_tmpl.items():
+            widths = {np.dtype(a.dtype)}
+            if n in alt_plan:
+                widths.add(np.dtype(alt_plan[n]))
+            accept_flat[n] = frozenset(widths)
+        self._accept_dtypes = jax.tree.leaves(unflatten_tree(accept_flat))
+        # Bound guards for the mixed-fleet door (review round 2): a
+        # FULL-WIDTH int row admitted into a narrow ring is cast by the
+        # staging copy / in-program astype with no range check, which
+        # would WRAP silently — the exact failure the encode path's
+        # exactness guard fails loudly on. Guard the buffer door the same
+        # way: np.iinfo of the narrow target per int-narrowed leaf, in
+        # template leaf order (same discipline as ``_accept_dtypes``);
+        # the scan runs only on rows arriving wider than the store.
+        guard_flat = {
+            n: (
+                np.iinfo(self._wire_plan[n])
+                if n in self._wire_plan
+                and np.dtype(self._wire_plan[n]).kind == "i"
+                else 0
+            )
+            for n in flat_tmpl
+        }
+        self._int_guards = jax.tree.leaves(unflatten_tree(guard_flat))
+        self._has_int_guards = any(g != 0 for g in self._int_guards)
+        self._tel.counter("buffer/intbound_rejected_total")
+        if self._wire_plan:
+            template = unflatten_tree(
+                apply_cast_plan(flat_tmpl, self._wire_plan)
+            )
+        self._store_dtypes = jax.tree.map(lambda x: np.dtype(x.dtype), template)
         self._store = jax.tree.map(
             lambda x: jax.device_put(x, self._sharding), template
         )
@@ -127,6 +212,7 @@ class TrajectoryBuffer:
         self.dropped_stale = 0
         self.dropped_overflow = 0
         self.dropped_skew = 0
+        self.dropped_bounds = 0
         self.ingested = 0
         # Per-slot leaf spec for the ingest-door shape guard: a rollout from
         # a config-skewed actor (different rollout_len / obs shapes / model
@@ -137,6 +223,7 @@ class TrajectoryBuffer:
             (x.shape[1:], np.dtype(x.dtype)) for x in jax.tree.leaves(template)
         ]
         self._skew_warned = False
+        self._bounds_warned = False
         # Host staging lanes (BufferConfig.staging_slots): the ingest path
         # copies decoded rows into one of these REUSED preallocated numpy
         # buffers instead of np.stack-allocating per call, rotating lanes so
@@ -159,15 +246,27 @@ class TrajectoryBuffer:
 
         def _scatter_impl(store, rows, idx):
             self.scatter_traces += 1   # runs at trace time only
-            return jax.tree.map(lambda s, r: s.at[idx].set(r), store, rows)
+            # dtype-aware: rows arriving wider than the store (the
+            # device-rollout path's f32 chunks into a narrow ring, or an
+            # f32-knob actor at a narrow learner) are cast in-program; a
+            # same-dtype astype is free in XLA
+            return jax.tree.map(
+                lambda s, r: s.at[idx].set(r.astype(s.dtype)), store, rows
+            )
 
         self._scatter = jax.jit(
             _scatter_impl,
             donate_argnums=(0,),
             out_shardings=jax.tree.map(lambda _: self._sharding, template),
         )
+        # Consume-time upcast (ISSUE 7): the gather restores the train
+        # dtypes in the same jitted program — the only place narrow rows
+        # widen, and it runs on-device (no host copy ever sees f32).
+        consume_dtypes = self._consume_dtypes
         self._gather = jax.jit(
-            lambda store, idx: jax.tree.map(lambda s: s[idx], store),
+            lambda store, idx: jax.tree.map(
+                lambda s, d: s[idx].astype(d), store, consume_dtypes
+            ),
             out_shardings=jax.tree.map(lambda _: self._sharding, template),
         )
 
@@ -215,6 +314,24 @@ class TrajectoryBuffer:
                         "not match this learner's config (actor running a "
                         "different rollout_len/obs/model config?) — align "
                         "actor and learner configs"
+                    )
+                continue
+            if self._has_int_guards and not self._payload_in_bounds(arrays):
+                # Mixed-fleet bound guard (ISSUE 7): a FULL-WIDTH int row
+                # headed into a narrow ring would wrap silently at the
+                # staging/scatter cast — the exact corruption the encode
+                # path fails loudly on. Same door policy as nonfinite:
+                # counted, never fatal.
+                self.dropped_bounds += 1
+                self._tel.counter("buffer/intbound_rejected_total").inc()
+                if not self._bounds_warned:
+                    self._bounds_warned = True
+                    logger.warning(
+                        "trajectory_buffer: dropping full-width rollout "
+                        "whose integer leaves exceed this learner's "
+                        "narrow-ring bounds (rollout_int_bounds promise "
+                        "violated by an f32-wire actor?) — fix the actor "
+                        "or widen rollout_int_bounds"
                     )
                 continue
             if self._reject_nonfinite and not self._payload_finite(arrays):
@@ -273,22 +390,55 @@ class TrajectoryBuffer:
     def _payload_finite(self, arrays: Any) -> bool:
         """True iff every float leaf of a host payload is finite. One
         vectorized pass per leaf — the staging copy touches the same bytes
-        anyway, so the scan rides the ingest's existing memory traffic."""
+        anyway, so the scan rides the ingest's existing memory traffic.
+
+        Narrow-dtype rows (ISSUE 7) are scanned DIRECTLY: ml_dtypes
+        registers a native ``np.isfinite`` loop for bfloat16 (a bf16 NaN
+        is still a NaN), so the pass never materializes an f32 upcast
+        copy — pinned by a test. Note bf16's numpy ``dtype.kind`` is
+        ``'V'``, not ``'f'``: the kind check alone would silently skip
+        exactly the leaves the narrow wire carries."""
         for leaf in jax.tree.leaves(arrays):
             a = np.asarray(leaf)
-            if a.dtype.kind == "f" and not np.isfinite(a).all():
+            if (
+                a.dtype.kind == "f" or a.dtype == _WIRE_BF16
+            ) and not np.isfinite(a).all():
+                return False
+        return True
+
+    def _payload_in_bounds(self, arrays: Any) -> bool:
+        """True iff every int leaf arriving WIDER than its narrow store
+        dtype fits that dtype's range. Only the mixed-fleet path pays the
+        min/max pass (a row already at the narrow width fits by dtype;
+        a full-width ring has no guards at all)."""
+        for leaf, guard in zip(jax.tree.leaves(arrays), self._int_guards):
+            if guard == 0:
+                continue
+            a = np.asarray(leaf)
+            if (
+                a.dtype.kind == "i"
+                and a.dtype.itemsize > guard.dtype.itemsize
+                and a.size
+                and (a.min() < guard.min or a.max() > guard.max)
+            ):
                 return False
         return True
 
     def _matches_slot(self, arrays: Any) -> bool:
-        """True iff ``arrays`` has exactly the slot pytree/shape/dtype."""
+        """True iff ``arrays`` has exactly the slot pytree/shapes, with
+        every leaf at one of its admissible widths (the stored dtype, the
+        original full width, or the narrow wire width — see
+        ``_accept_dtypes``; the staging copy casts on assignment). Any
+        other dtype is config skew and drops at the door."""
         try:
             if jax.tree.structure(arrays) != self._tmpl_struct:
                 return False
             return all(
-                np.shape(leaf) == shape and np.asarray(leaf).dtype == dtype
-                for leaf, (shape, dtype) in zip(
-                    jax.tree.leaves(arrays), self._tmpl_leaves
+                np.shape(leaf) == shape and np.asarray(leaf).dtype in accept
+                for leaf, (shape, _), accept in zip(
+                    jax.tree.leaves(arrays),
+                    self._tmpl_leaves,
+                    self._accept_dtypes,
                 )
             )
         except (TypeError, ValueError, AttributeError):
@@ -521,16 +671,46 @@ class TrajectoryBuffer:
                     int(self._warmed), self.dropped_stale,
                     self.dropped_overflow, self.ingested,
                     self.dropped_skew, self.dropped_nonfinite,
+                    self.dropped_bounds,
                 ],
                 np.int64,
             ),
         }
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
-        self._store = jax.tree.map(
-            lambda x: jax.device_put(np.asarray(x), self._sharding),
-            state["store"],
-        )
+        def _put(x, dtype):
+            a = np.asarray(x)   # host-sync-ok: checkpoint-restore host arrays
+            if a.dtype != dtype:
+                # snapshot written under a different rollout_wire_dtype
+                # (f32 ring restored into a narrow config, or vice versa):
+                # cast to THIS config's storage width — exact upward,
+                # quantizing floats downward like a fresh ingest; int
+                # slots that would WRAP are freed below instead
+                a = a.astype(dtype)
+            return jax.device_put(a, self._sharding)
+
+        # Same bound guard the ingest door runs (`_payload_in_bounds`): a
+        # full-width snapshot restored into a narrow ring would WRAP any
+        # out-of-range int slot at the astype below — scan per slot first
+        # and free the offenders instead (counted, never fatal, exactly
+        # the fresh-ingest policy for the same rows).
+        bad_slots = np.zeros((self.capacity,), bool)
+        if self._has_int_guards:
+            for leaf, guard in zip(
+                jax.tree.leaves(state["store"]), self._int_guards
+            ):
+                if guard == 0:
+                    continue
+                a = np.asarray(leaf)   # host-sync-ok: checkpoint-restore
+                if (
+                    a.dtype.kind == "i"
+                    and a.dtype.itemsize > guard.dtype.itemsize
+                    and a.shape[:1] == (self.capacity,)
+                ):
+                    over = (a < guard.min) | (a > guard.max)
+                    bad_slots |= over.reshape(self.capacity, -1).any(axis=1)
+
+        self._store = jax.tree.map(_put, state["store"], self._store_dtypes)
         self._order = deque(
             int(s) for s in np.asarray(state["order"]) if s >= 0
         )
@@ -538,16 +718,38 @@ class TrajectoryBuffer:
         self._held = {}   # snapshots never carry in-flight holds
         self._slot_version = np.asarray(state["slot_version"]).copy()
         counters = [int(v) for v in np.asarray(state["counters"])]
-        # snapshots written before dropped_skew/dropped_nonfinite joined
-        # the array carry fewer entries; missing counters resume at 0
-        counters += [0] * (6 - len(counters))
-        warmed, stale, overflow, ingested, skew, nonfinite = counters[:6]
+        # snapshots written before dropped_skew/dropped_nonfinite/
+        # dropped_bounds joined the array carry fewer entries; missing
+        # counters resume at 0
+        counters += [0] * (7 - len(counters))
+        (warmed, stale, overflow, ingested, skew, nonfinite,
+         bounds) = counters[:7]
         self._warmed = bool(warmed)
         self.dropped_stale = stale
         self.dropped_overflow = overflow
         self.ingested = ingested
         self.dropped_skew = skew
         self.dropped_nonfinite = nonfinite
+        self.dropped_bounds = bounds
+        dropped = (
+            [s for s in self._order if bad_slots[s]]
+            if bad_slots.any()
+            else []
+        )
+        if dropped:
+            self._order = deque(s for s in self._order if not bad_slots[s])
+            self._free.extend(dropped)
+            self.dropped_bounds += len(dropped)
+            self._tel.counter("buffer/intbound_rejected_total").inc(
+                len(dropped)
+            )
+            logger.warning(
+                "trajectory_buffer: freed %d restored slot(s) whose int "
+                "values exceed this config's narrow wire bounds (snapshot "
+                "written under a wider rollout_wire_dtype?) — casting "
+                "them would wrap silently",
+                len(dropped),
+            )
 
     def _publish_telemetry(self) -> None:
         """Mirror the host-side bookkeeping into the registry (gauges are
@@ -563,6 +765,9 @@ class TrajectoryBuffer:
         self._tel.gauge("buffer/dropped_nonfinite").set(
             float(self.dropped_nonfinite)
         )
+        self._tel.gauge("buffer/dropped_bounds").set(
+            float(self.dropped_bounds)
+        )
 
     def metrics(self) -> Dict[str, float]:
         return {
@@ -572,4 +777,5 @@ class TrajectoryBuffer:
             "buffer_dropped_overflow": float(self.dropped_overflow),
             "buffer_dropped_skew": float(self.dropped_skew),
             "buffer_dropped_nonfinite": float(self.dropped_nonfinite),
+            "buffer_dropped_bounds": float(self.dropped_bounds),
         }

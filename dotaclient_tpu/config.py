@@ -265,9 +265,24 @@ class TransportConfig:
     # Wire dtype for the weights fanout: "float32" (bit-exact) or
     # "bfloat16" — float32 params are cast at encode, halving the fanout
     # bytes per publish; the actor upcasts on apply (lossless: every bf16
-    # value is exactly representable in f32). Rollout payloads are
-    # untouched (actors already choose their own compute dtype).
+    # value is exactly representable in f32).
     wire_dtype: str = "float32"
+    # Wire dtype for ROLLOUT payloads (ISSUE 7) — the dominant byte stream
+    # at scale: "float32" (bit-exact, the default) or "bfloat16". With
+    # bfloat16, actors narrow f32 observation/feature leaves to bf16 (and
+    # config-bounded integer leaves — action indices, hero ids — to
+    # int8/int16, exactly) at encode, the ``__wire_cast__`` marker names
+    # what was narrowed, the learner's trajectory buffer STORES the narrow
+    # dtypes (≈half the resident HBM ring bytes and per-scatter H2D
+    # traffic), and the upcast to f32 happens on-device inside the already
+    # jitted consume gather — the train step sees f32 inputs bit-identical
+    # to decoding the wire. Precision-critical leaves (behavior_logp,
+    # rewards, dones, values, LSTM initial carries) are pinned f32 by
+    # serialize.rollout_leaf_pinned and cross the wire byte-identical, so
+    # PPO ratios and GAE are untouched. Keep actor and learner values
+    # aligned (the buffer tolerates either width at the door, but mixed
+    # fleets forfeit the bandwidth win on the f32 side).
+    rollout_wire_dtype: str = "float32"
     # A connection whose writer thread is still stuck sending when this
     # many NEWER publishes have been enqueued is declared over-budget and
     # dropped (counted in transport/fanout_conns_dropped) — a stalled actor

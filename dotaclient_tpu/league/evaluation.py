@@ -48,6 +48,12 @@ def evaluate(
         # distribution lever) would silently swap a fraction of the games
         # to the scripted bot and contaminate the reported win_rate
         league=dataclasses.replace(config.league, anchor_prob=0.0),
+        # eval chunks are drained for stats and DROPPED — never stored or
+        # shipped — so the rollout wire narrowing would be pure wasted
+        # in-program casts per collect
+        transport=dataclasses.replace(
+            config.transport, rollout_wire_dtype="float32"
+        ),
     )
     # the eval actor records into a PRIVATE registry: its frames/collect
     # latencies (different config, different cadence) must not contaminate

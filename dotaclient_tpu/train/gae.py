@@ -1,12 +1,15 @@
 """Generalized Advantage Estimation as a reverse ``lax.scan``.
 
-The reference computes GAE over the time axis inside its learner
+The reference computed GAE as a sequential Python/torch loop in its learner
 (SURVEY.md §3.2, BASELINE.json:5; reconstructed — the reference checkout was
-an empty mount). A sequential Python/torch loop there; here a single
-``lax.scan`` over time, batched over rollouts, fully inside jit so XLA fuses
-it with the surrounding loss computation (HEPPO-GAE, PAPERS.md, covers the
-hardware-friendly formulation space — a scan is already bandwidth-bound
-optimal at these sizes).
+an empty mount). Here GAE runs ON DEVICE, INSIDE the jitted train step: the
+loss function calls :func:`gae` directly (``train/ppo.py:153``), so the
+reverse scan over time — batched over rollouts — compiles into the same XLA
+program as the forward pass, loss, and gradient, and XLA fuses it with the
+surrounding computation. There is no host-side GAE pass anywhere in the
+pipeline; values come from the current policy's forward in that same
+program (HEPPO-GAE, PAPERS.md, covers the hardware-friendly formulation
+space — a scan is already bandwidth-bound optimal at these sizes).
 """
 
 from __future__ import annotations

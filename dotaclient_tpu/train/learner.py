@@ -1718,6 +1718,17 @@ def main(argv=None) -> Dict[str, float]:
         "bfloat16 halves fanout bytes, actors upcast on apply",
     )
     p.add_argument(
+        "--rollout-wire-dtype", type=str, default=None,
+        choices=("float32", "bfloat16"),
+        help="rollout payload wire dtype (overrides "
+        "transport.rollout_wire_dtype); bfloat16 roughly halves experience "
+        "wire bytes AND the resident trajectory-ring bytes (the ring "
+        "stores the narrow dtypes; the upcast to f32 runs on-device at "
+        "consume). Precision-critical leaves (behavior_logp, rewards, "
+        "dones, carries) stay f32 on the wire. Set the SAME value on "
+        "actors (docs/OPERATIONS.md)",
+    )
+    p.add_argument(
         "--amqp-host", type=str, default="localhost",
         help="broker address for --transport amqp",
     )
@@ -1854,6 +1865,13 @@ def main(argv=None) -> Dict[str, float]:
         config = dataclasses.replace(
             config, transport=dataclasses.replace(
                 config.transport, wire_dtype=args.wire_dtype
+            )
+        )
+    if args.rollout_wire_dtype is not None:
+        config = dataclasses.replace(
+            config, transport=dataclasses.replace(
+                config.transport,
+                rollout_wire_dtype=args.rollout_wire_dtype,
             )
         )
     if args.sync_snapshots:

@@ -18,7 +18,7 @@ from __future__ import annotations
 import ctypes
 import threading
 import zlib
-from typing import Any, Dict, Mapping, Tuple
+from typing import Any, Dict, List, Mapping, Tuple
 
 import numpy as np
 
@@ -126,6 +126,260 @@ def unflatten_tree(flat: Mapping[str, np.ndarray]) -> Dict[str, Any]:
     return fix(nested)
 
 
+# -- rollout wire narrowing (ISSUE 7) -----------------------------------------
+#
+# The experience stream is the dominant byte flow at scale: every actor ships
+# one encoded chunk per finished lane, and PR 3's bf16 weights discipline left
+# rollout payloads full-width f32. ``TransportConfig.rollout_wire_dtype``
+# extends the same in-band ``__wire_cast__`` marker discipline to rollouts:
+# f32 observation/feature leaves narrow to bf16 at encode, bounded
+# integer-like leaves (action indices, hero ids — the producer's config
+# bounds their range) narrow to int8/int16 where the cast is exact, and the
+# marker entry names exactly what was narrowed (``name=orig_dtype`` lines).
+# Decode keeps the narrow dtypes by default — the trajectory buffer stores
+# them narrow and upcasts on-device at consume time — and ``upcast=True``
+# restores the original dtypes on host (bf16→f32 and int8→int32 are exact,
+# so the restored batch is bit-identical to what an f32 wire would have
+# carried for bf16-representable inputs).
+#
+# Precision-critical leaves are PINNED f32 by the allowlist below and cross
+# the wire byte-identical: behavior_logp feeds the PPO importance ratio
+# exp(logp - behavior_logp) where bf16's 8 mantissa bits would inject
+# O(0.4%) multiplicative noise into every surrogate term; rewards/values
+# accumulate over the GAE scan (quantization noise compounds across T);
+# dones gates the recursion; the LSTM initial carries (carry0/*) seed the
+# whole sequence forward.
+
+ROLLOUT_WIRE_DTYPES = ("float32", "bfloat16")
+_ROLLOUT_PINNED_NAMES = frozenset(
+    {"behavior_logp", "rewards", "dones", "values"}
+)
+_ROLLOUT_PINNED_PREFIXES = ("carry0/",)
+
+
+def rollout_leaf_pinned(name: str) -> bool:
+    """True iff this rollout leaf must cross the wire at full width."""
+    return name in _ROLLOUT_PINNED_NAMES or name.startswith(
+        _ROLLOUT_PINNED_PREFIXES
+    )
+
+
+def rollout_int_bounds(config) -> Dict[str, int]:
+    """Max values the producer's config guarantees for integer-like rollout
+    leaves — the input that licenses exact int8/int16 narrowing. Computed
+    from the SAME RunConfig on both ends (actor encode, learner buffer
+    template), so the dtypes agree wherever the configs do (the buffer's
+    skew check already requires that)."""
+    bounds = {
+        f"actions/{head}": size - 1
+        for head, size in config.actions.head_sizes.items()
+    }
+    bounds["obs/hero_id"] = config.model.n_hero_ids - 1
+    # Unit handles are sim-assigned identities: the vectorized/device sims
+    # use slot permutations (≤ max_units), the scalar sim increments per
+    # spawn (~hundreds over a 600 s game). int16 is exact for both with
+    # orders of magnitude of headroom, and the encode path VERIFIES the
+    # range before casting (a handle source that ever outgrew the bound
+    # fails loudly instead of wrapping).
+    bounds["obs/unit_handles"] = np.iinfo(np.int16).max
+    return bounds
+
+
+def decode_drained_payloads(
+    payloads, tel, totals: List[int]
+) -> "Tuple[list, int]":
+    """Decode a transport drain's wire payloads with the SHARED wire/raw
+    byte accounting (ISSUE 7) — the one copy of the accounting both the
+    socket and shm consume paths run, so the ``--require-wire`` telemetry
+    can never diverge between lanes. ``totals`` is the server's mutable
+    ``[wire_total, raw_total]`` pair (updated in place). Returns
+    ``(decoded (meta, arrays) pairs, malformed-payload count)`` —
+    malformed payloads (version-skewed actors, port scanners) are counted
+    and dropped, the disposable-actor failure model (SURVEY.md §5.3)."""
+    out = []
+    bad = 0
+    wire = raw = 0
+    for p in payloads:
+        try:
+            meta, arrays = decode_rollout_bytes(p)
+        except Exception:
+            bad += 1
+            continue
+        # actual bytes consumed vs what the same payloads would have cost
+        # full-width — the decoder computed both from the in-band cast
+        # marker (host ints only)
+        wire += meta.get("wire_bytes", len(p))
+        raw += meta.get("raw_bytes", len(p))
+        out.append((meta, arrays))
+    if out:
+        totals[0] += wire
+        totals[1] += raw
+        tel.counter("transport/rollout_bytes_total").inc(wire)
+        tel.counter("transport/rollout_raw_bytes_total").inc(raw)
+        if totals[0]:   # zero-length payloads leave the gauge at its floor
+            tel.gauge("transport/rollout_compression_ratio").set(
+                totals[1] / totals[0]
+            )
+    return out, bad
+
+
+def rollout_wire_kwargs(config) -> Dict[str, Any]:
+    """The encode-call kwargs this config's rollout wire needs — ``{}``
+    for a full-width wire. The ONE derivation every encoder shares
+    (actor pools, bench): a change to the encode contract (a new bound
+    source, say) lands here once instead of drifting across hand-rolled
+    copies."""
+    if config.transport.rollout_wire_dtype == "float32":
+        return {}
+    return dict(
+        wire_dtype=config.transport.rollout_wire_dtype,
+        int_bounds=rollout_int_bounds(config),
+    )
+
+
+def rollout_cast_plan(
+    specs: Mapping[str, Any],
+    wire_dtype: str,
+    int_bounds: "Mapping[str, int] | None" = None,
+) -> Dict[str, np.dtype]:
+    """``leaf name → narrow dtype`` for the leaves that change on the wire.
+
+    ``specs`` maps flat leaf names to dtypes (anything ``np.dtype``
+    accepts). Only f32 leaves off the pinned allowlist narrow to bf16;
+    signed-integer leaves narrow to int8/int16 only when ``int_bounds``
+    names them with a config-guaranteed max value that fits — exact by
+    construction, never value-sniffed (a value-dependent plan would make
+    one actor's chunks dtype-unstable and trip the buffer's skew check).
+    """
+    if wire_dtype not in ROLLOUT_WIRE_DTYPES:
+        raise ValueError(
+            f"unknown rollout_wire_dtype {wire_dtype!r} "
+            f"(expected one of {ROLLOUT_WIRE_DTYPES})"
+        )
+    if wire_dtype == "float32":
+        return {}
+    if _BFLOAT16 is None:
+        raise ValueError(
+            "rollout_wire_dtype=bfloat16 but ml_dtypes unavailable"
+        )
+    plan: Dict[str, np.dtype] = {}
+    for name, dtype in specs.items():
+        dtype = np.dtype(dtype)
+        if rollout_leaf_pinned(name):
+            continue
+        if dtype == np.float32:
+            plan[name] = _BFLOAT16
+        elif dtype.kind == "i" and int_bounds and name in int_bounds:
+            bound = int(int_bounds[name])
+            if 0 <= bound <= np.iinfo(np.int8).max and dtype.itemsize > 1:
+                plan[name] = np.dtype(np.int8)
+            elif 0 <= bound <= np.iinfo(np.int16).max and dtype.itemsize > 2:
+                plan[name] = np.dtype(np.int16)
+    return plan
+
+
+def apply_cast_plan(
+    flat: Mapping[str, Any], plan: "Mapping[str, np.dtype]"
+) -> Dict[str, Any]:
+    """Apply a :func:`rollout_cast_plan` to a flat leaf dict — the ONE
+    place the cast lands. The host encode path, the buffer's narrow
+    template, and the device collect program all route through here, so a
+    new narrowed kind changes dtype in lockstep at every site (three
+    hand-rolled copies would let the actor, ring, and wire silently
+    disagree and trip the buffer's skew check). Works on numpy arrays and
+    jax tracers alike (both carry ``astype``)."""
+    return {
+        n: (a.astype(plan[n]) if n in plan else a) for n, a in flat.items()
+    }
+
+
+_CAST_PLAN_CACHE: Dict[tuple, tuple] = {}
+
+
+def _narrow_rollout_flat(
+    flat: Dict[str, Any],
+    wire_dtype: str,
+    int_bounds: "Mapping[str, int] | None",
+) -> "Tuple[Dict[str, Any], bytes | None]":
+    """Apply the cast plan to a flat leaf dict; returns ``(flat', marker
+    blob)`` where the blob is the newline-joined ``name=orig_dtype`` record
+    the decoder needs to restore the original dtypes (None when nothing
+    narrowed — an f32 wire carries no marker).
+
+    The plan and marker are pure functions of (leaf names, dtypes,
+    wire_dtype, bounds) and rollout structure is fixed across an actor's
+    lifetime (the ``_SPEC_CACHE`` premise), so both are memoized — the
+    per-chunk ship path pays only the int range verification and the
+    casts themselves."""
+    if wire_dtype == "float32":
+        # feature off (the default): skip even the memo-key build — this
+        # is every actor's per-chunk ship path
+        return flat, None
+    key = (
+        tuple((n, _dtype_name(np.dtype(a.dtype))) for n, a in flat.items()),
+        wire_dtype,
+        tuple(sorted(int_bounds.items())) if int_bounds else None,
+    )
+    cached = _CAST_PLAN_CACHE.get(key)
+    if cached is None:
+        plan = rollout_cast_plan(
+            {n: a.dtype for n, a in flat.items()}, wire_dtype, int_bounds
+        )
+        marker = (
+            "\n".join(
+                f"{name}={_dtype_name(np.dtype(flat[name].dtype))}"
+                for name in plan
+            ).encode()
+            if plan
+            else None
+        )
+        _CAST_PLAN_CACHE[key] = cached = (plan, marker)
+    plan, marker = cached
+    if not plan:
+        return flat, None
+    for name, narrow in plan.items():
+        arr = flat[name]
+        if np.dtype(narrow).kind == "i" and isinstance(arr, np.ndarray):
+            # exactness guard: the int bound is a config PROMISE — verify
+            # it on the host path before a silent wrap could corrupt the
+            # stream (the device path casts in-graph and relies on the
+            # sim's by-construction bounds)
+            info = np.iinfo(narrow)
+            if arr.size and (
+                arr.min() < info.min or arr.max() > info.max
+            ):
+                raise ValueError(
+                    f"rollout leaf {name!r} exceeds its declared int bound "
+                    f"({info.max}): observed range "
+                    f"[{arr.min()}, {arr.max()}] does not fit {info.dtype} "
+                    f"— fix rollout_int_bounds or widen the cast"
+                )
+    return apply_cast_plan(flat, plan), marker
+
+
+def _parse_cast_marker(blob: bytes) -> Dict[str, str]:
+    """Marker blob → ``{leaf name: original dtype name}``."""
+    cast: Dict[str, str] = {}
+    for line in blob.decode().split("\n"):
+        if not line:
+            continue
+        name, _, orig = line.partition("=")
+        cast[name] = orig
+    return cast
+
+
+def _upcast_flat(
+    flat: Dict[str, np.ndarray], cast: Mapping[str, str]
+) -> Dict[str, np.ndarray]:
+    """Restore narrowed leaves to their original dtypes (exact: every bf16
+    value is representable in f32, every int8/int16 in int32)."""
+    for name, orig in cast.items():
+        arr = flat.get(name)
+        if arr is not None:
+            flat[name] = arr.astype(_np_dtype(orig))
+    return flat
+
+
 def tensor_to_proto(arr: np.ndarray) -> pb.TensorProto:
     arr = np.ascontiguousarray(arr)
     return pb.TensorProto(
@@ -145,8 +399,14 @@ def encode_rollout(
     rollout_id: int,
     length: int,
     total_reward: float,
+    wire_dtype: str = "float32",
+    int_bounds: "Mapping[str, int] | None" = None,
 ) -> pb.Rollout:
-    """Serialize one rollout's pytree of host arrays."""
+    """Serialize one rollout's pytree of host arrays.
+
+    ``wire_dtype="bfloat16"`` narrows the experience leaves per
+    :func:`rollout_cast_plan` (pinned leaves stay byte-identical f32) and
+    records the casts in the in-band ``__wire_cast__`` marker entry."""
     r = pb.Rollout(
         model_version=model_version,
         env_id=env_id,
@@ -154,13 +414,29 @@ def encode_rollout(
         length=length,
         total_reward=total_reward,
     )
-    for name, arr in flatten_tree(arrays).items():
+    flat = flatten_tree(arrays)
+    flat, marker = _narrow_rollout_flat(flat, wire_dtype, int_bounds)
+    n_entries = len(flat) + (1 if marker is not None else 0)
+    if n_entries > _MAX_TENSORS:
+        _raise_too_many_tensors(n_entries, "encode")
+    for name, arr in flat.items():
         r.arrays[name].CopyFrom(tensor_to_proto(arr))
+    if marker is not None:
+        r.arrays[_WIRE_CAST_MARKER].CopyFrom(
+            pb.TensorProto(shape=[len(marker)], dtype="marker", data=marker)
+        )
     return r
 
 
-def decode_rollout(r: pb.Rollout) -> Tuple[Dict[str, Any], Any]:
-    """Deserialize → (meta dict, pytree of arrays)."""
+def decode_rollout(
+    r: pb.Rollout, upcast: bool = False
+) -> Tuple[Dict[str, Any], Any]:
+    """Deserialize → (meta dict, pytree of arrays).
+
+    Narrowed leaves come back in their WIRE dtypes by default (the
+    trajectory buffer stores them narrow and upcasts on-device at consume
+    time); the marker record lands in ``meta["wire_cast"]``. ``upcast=True``
+    restores the original dtypes on host (tests, non-buffer consumers)."""
     meta = {
         "model_version": r.model_version,
         "env_id": r.env_id,
@@ -168,7 +444,17 @@ def decode_rollout(r: pb.Rollout) -> Tuple[Dict[str, Any], Any]:
         "length": r.length,
         "total_reward": r.total_reward,
     }
-    flat = {name: proto_to_tensor(t) for name, t in r.arrays.items()}
+    flat = {}
+    cast: Dict[str, str] = {}
+    for name, t in r.arrays.items():
+        if name == _WIRE_CAST_MARKER:
+            cast = _parse_cast_marker(t.data)
+            continue
+        flat[name] = proto_to_tensor(t)
+    if cast:
+        meta["wire_cast"] = cast
+        if upcast:
+            flat = _upcast_flat(flat, cast)
     return meta, unflatten_tree(flat)
 
 
@@ -207,8 +493,19 @@ def _entry_buffer():
     return buf
 
 
+def _raise_too_many_tensors(n_entries: int, side: str) -> None:
+    raise ValueError(
+        f"rollout payload carries {n_entries} tensor entries at {side}; the "
+        f"native wire codec's entry table holds at most {_MAX_TENSORS} — a "
+        f"silent fallback here would walk a truncated entry buffer (decode) "
+        f"or pin the learner to the slow proto parser forever (encode). "
+        f"Flatten fewer leaves or raise _MAX_TENSORS in "
+        f"transport/serialize.py"
+    )
+
+
 def decode_rollout_bytes(
-    payload: bytes, native: bool = True
+    payload: bytes, native: bool = True, upcast: bool = False
 ) -> Tuple[Dict[str, Any], Any]:
     """Decode a serialized ``Rollout`` from raw bytes.
 
@@ -220,6 +517,20 @@ def decode_rollout_bytes(
     of its drain snapshots — no copy on the way in either). Views are
     read-only — callers that mutate must copy (the trajectory buffer only
     uploads, so the hot path never does).
+
+    Wire-narrowed payloads (``rollout_wire_dtype``, ISSUE 7) decode to
+    their NARROW dtypes by default — the trajectory buffer keeps them
+    narrow and the upcast happens on-device at consume time. The marker
+    record lands in ``meta["wire_cast"]`` and the byte accounting in
+    ``meta["wire_bytes"]`` / ``meta["raw_bytes"]`` (what the same payload
+    would have cost full-width — the transports' compression telemetry).
+    ``upcast=True`` restores original dtypes on host (a copy; tests and
+    non-buffer consumers).
+
+    A payload with more tensor entries than the native table holds raises
+    ``ValueError`` naming the count (the transports' consume paths count
+    it as a bad payload) — never a silent fall-through that would leave a
+    truncated entry walk or a permanent slow-path downgrade.
     """
     if not isinstance(payload, (bytes, bytearray, memoryview)):
         payload = bytes(payload)  # exotic bytes-like in
@@ -246,14 +557,32 @@ def decode_rollout_bytes(
                 entries.ctypes.data_as(ctypes.POINTER(TensorEntry)),
                 _MAX_TENSORS,
             )
+            if n == -2:
+                # entry-table overflow: loud, with the real count (the
+                # payload is well-formed proto — count it; if it is NOT
+                # parseable either, fall through to the proto path's own
+                # parse error)
+                try:
+                    r = pb.Rollout()
+                    r.ParseFromString(bytes(payload))
+                except Exception:
+                    pass
+                else:
+                    _raise_too_many_tensors(len(r.arrays), "decode")
             if n >= 0:
                 flat = {}
+                cast: Dict[str, str] = {}
                 # one C-level conversion: rows become plain python tuples
                 for (
                     name_off, name_len, dtype_off, dtype_len,
                     data_off, data_len, shape, ndim,
                 ) in entries[:n].tolist():
                     name = bytes(payload[name_off:name_off + name_len]).decode()
+                    if name == _WIRE_CAST_MARKER:
+                        cast = _parse_cast_marker(
+                            bytes(payload[data_off:data_off + data_len])
+                        )
+                        continue
                     dkey = bytes(payload[dtype_off:dtype_off + dtype_len])
                     dtype = _DTYPE_CACHE.get(dkey)
                     if dtype is None:
@@ -273,13 +602,120 @@ def decode_rollout_bytes(
                     "length": hdr.length,
                     "total_reward": hdr.total_reward,
                 }
+                if cast:
+                    # narrowed payloads carry their byte accounting; plain
+                    # f32 frames keep the historical meta shape exactly
+                    # (consume telemetry falls back to len(payload))
+                    meta["wire_cast"] = cast
+                    _attach_wire_accounting(meta, flat, cast, len(payload))
+                    if upcast:
+                        flat = _upcast_flat(flat, cast)
                 return meta, unflatten_tree(flat)
-            # n == -2 (too many tensors) or malformed: fall through
+            # n == -1 (malformed): fall through to the proto parser
     r = pb.Rollout()
     r.ParseFromString(
         payload if isinstance(payload, bytes) else bytes(payload)
     )
-    return decode_rollout(r)
+    if len(r.arrays) > _MAX_TENSORS:
+        _raise_too_many_tensors(len(r.arrays), "decode")
+    meta, arrays = decode_rollout(r, upcast=upcast)
+    if meta.get("wire_cast"):
+        meta["wire_bytes"] = len(payload)
+        raw = len(payload) - _wire_cast_overhead(meta["wire_cast"])
+        for name, orig in meta["wire_cast"].items():
+            # `in` before indexing: protobuf map __getitem__ auto-inserts
+            if name in r.arrays:
+                t = r.arrays[name]
+                raw += _leaf_raw_delta(
+                    name, tuple(t.shape), _np_dtype(t.dtype), orig
+                )
+        meta["raw_bytes"] = raw
+    return meta, arrays
+
+
+def _varint_size(n: int) -> int:
+    """Bytes a proto3 varint of ``n`` occupies."""
+    size = 1
+    while n > 0x7F:
+        n >>= 7
+        size += 1
+    return size
+
+
+def _wire_cast_overhead(cast: Mapping[str, str]) -> int:
+    """Exact wire footprint of the ``__wire_cast__`` marker map entry —
+    an f32 payload carries NO marker, so ``raw_bytes`` must exclude it or
+    the compression ratio overstates the saving (~0.4% on the default
+    config). Both codecs emit canonical proto3 (asserted equal in tests),
+    so the size is computable from the blob length alone: TensorProto
+    {shape=[blob_len] packed, dtype="marker", data=blob} wrapped in a map
+    entry wrapped in Rollout field 6 (all tags are one byte)."""
+    blob_len = sum(
+        len(n) + 1 + len(o) for n, o in cast.items()
+    ) + max(0, len(cast) - 1)   # name=orig lines, newline-joined
+    packed = _varint_size(blob_len)
+    tensor = (
+        1 + _varint_size(packed) + packed
+        + 1 + 1 + len("marker")
+        + 1 + _varint_size(blob_len) + blob_len
+    )
+    key = len(_WIRE_CAST_MARKER)
+    entry = 1 + _varint_size(key) + key + 1 + _varint_size(tensor) + tensor
+    return 1 + _varint_size(entry) + entry
+
+
+def _leaf_raw_delta(
+    name: str, shape, narrow: np.dtype, orig_name: str
+) -> int:
+    """Exact wire-byte difference between this leaf's full-width and
+    narrow map entries: the data blob halves, but the dtype STRING also
+    changes length ("bfloat16" vs "float32" is +1, "int8" vs "int32" is
+    -1) and every length varint can change width — sub-byte effects that
+    would otherwise leave raw_bytes a few bytes off per payload."""
+    n = 1
+    for d in shape:
+        n *= int(d)
+    packed = sum(_varint_size(int(d)) for d in shape)
+    shape_field = (
+        (1 + _varint_size(packed) + packed) if len(shape) else 0
+    )
+    key = len(name)
+
+    def entry_total(dtype_name: str, itemsize: int) -> int:
+        dlen = n * itemsize
+        ds = len(dtype_name)
+        tensor = (
+            shape_field
+            + 1 + _varint_size(ds) + ds
+            + 1 + _varint_size(dlen) + dlen
+        )
+        e = 1 + _varint_size(key) + key + 1 + _varint_size(tensor) + tensor
+        return 1 + _varint_size(e) + e
+
+    return entry_total(orig_name, _np_dtype(orig_name).itemsize) - (
+        entry_total(_dtype_name(narrow), narrow.itemsize)
+    )
+
+
+def _attach_wire_accounting(
+    meta: Dict[str, Any],
+    flat: Mapping[str, np.ndarray],
+    cast: Mapping[str, str],
+    wire_bytes: int,
+) -> None:
+    """Record per-payload byte accounting: actual wire bytes and what the
+    same payload would have cost full-width — EXACTLY: the marker entry
+    exists only on the narrow wire (excluded from ``raw``), and each
+    narrowed leaf's framing is re-costed at its original dtype
+    (:func:`_leaf_raw_delta`). Pinned by a test asserting raw_bytes
+    equals the true f32 encode's length byte-for-byte."""
+    meta["wire_bytes"] = wire_bytes
+    raw = wire_bytes - _wire_cast_overhead(cast)
+    for name, orig in cast.items():
+        arr = flat.get(name)
+        if arr is not None:
+            raw += _leaf_raw_delta(name, arr.shape, arr.dtype, orig)
+    meta["raw_bytes"] = raw
 
 
 def encode_rollout_bytes(
@@ -290,6 +726,8 @@ def encode_rollout_bytes(
     length: int,
     total_reward: float,
     native: bool = True,
+    wire_dtype: str = "float32",
+    int_bounds: "Mapping[str, int] | None" = None,
 ) -> "bytes | memoryview":
     """Serialize one rollout straight to wire bytes (bytes-like).
 
@@ -300,6 +738,16 @@ def encode_rollout_bytes(
     protobuf's C++ runtime, SURVEY.md §2.2 row 3). Output parses
     identically to ``encode_rollout(...).SerializeToString()``; falls back
     to that when the library is unavailable (or a tensor exceeds 8 dims).
+
+    ``wire_dtype="bfloat16"`` (TransportConfig.rollout_wire_dtype) narrows
+    the experience leaves per :func:`rollout_cast_plan` before encoding —
+    roughly half the wire bytes per chunk — and ships the ``__wire_cast__``
+    marker entry naming exactly what was narrowed. The narrowed arrays ride
+    the same ``_SPEC_CACHE`` template path (their dtypes are part of the
+    cache key, so f32 and bf16 encodes of the same layout never share a
+    template). A rollout with more leaves than the native entry table
+    (``_MAX_TENSORS``) raises ``ValueError`` naming the count — encoding
+    it would produce payloads the native parser can never decode.
     """
     if native:
         from dotaclient_tpu.native.build import (
@@ -319,17 +767,32 @@ def encode_rollout_bytes(
                     f"{ctypes.sizeof(EncodeTensor)}"
                 )
             flat = flatten_tree(arrays)
+            flat, marker = _narrow_rollout_flat(flat, wire_dtype, int_bounds)
+            n_entries = len(flat) + (1 if marker is not None else 0)
+            if n_entries > _MAX_TENSORS:
+                _raise_too_many_tensors(n_entries, "encode")
             if all(a.ndim <= 8 for a in flat.values()):
-                n = len(flat)
+                names = list(flat)
                 arrs = [np.ascontiguousarray(a) for a in flat.values()]
+                dnames = [_dtype_name(a.dtype) for a in arrs]
+                if marker is not None:
+                    # the marker rides as one more entry: uint8 blob bytes,
+                    # dtype string "marker" (decode intercepts by NAME, so
+                    # the string only needs to match the proto path's)
+                    names.append(_WIRE_CAST_MARKER)
+                    arrs.append(np.frombuffer(marker, np.uint8))
+                    dnames.append("marker")
+                n = len(names)
                 # Rollout structure is fixed across an actor's lifetime, so
                 # everything but the data pointers — the EncodeTensor table,
                 # the names/dtypes blob, the size bound — is cached per
                 # (names, dtypes, shapes) key; the steady-state cost per call
-                # is one column write plus the C pass.
+                # is one column write plus the C pass. Narrowed layouts get
+                # their own key (the dtypes differ), so toggling
+                # rollout_wire_dtype can never serve a stale template.
                 key = tuple(
-                    (name, _dtype_name(a.dtype), a.shape)
-                    for name, a in zip(flat, arrs)
+                    (name, dname, a.shape)
+                    for name, dname, a in zip(names, dnames, arrs)
                 )
                 cached = _SPEC_CACHE.get(key)
                 if cached is None:
@@ -378,17 +841,22 @@ def encode_rollout_bytes(
                     # ParseFromString, and len() all take the view directly
                     return out[:written].data
     return encode_rollout(
-        arrays, model_version, env_id, rollout_id, length, total_reward
+        arrays, model_version, env_id, rollout_id, length, total_reward,
+        wire_dtype=wire_dtype, int_bounds=int_bounds,
     ).SerializeToString()
 
 
-# In-band wire-narrowing marker (the ModelWeights schema predates
-# wire_dtype and protoc is unavailable in this image to extend it): a
-# pseudo-entry in the params map whose ``data`` lists exactly the leaf
-# names the encoder cast f32→bf16, newline-joined. Decode upcasts ONLY
-# those — a natively-bf16 param (model.param_dtype="bfloat16") is never
-# silently widened. The "/"-free dunder name cannot collide with real
-# leaves (flax param paths always nest at least one module level).
+# In-band wire-narrowing marker (the proto schemas predate wire_dtype and
+# protoc is unavailable in this image to extend them): a pseudo-entry in
+# the params/arrays map recording exactly what the encoder narrowed.
+# Weights fanout: ``data`` lists the leaf names cast f32→bf16,
+# newline-joined — decode upcasts ONLY those, so a natively-bf16 param
+# (model.param_dtype="bfloat16") is never silently widened. Rollout
+# payloads (ISSUE 7): ``data`` lists ``name=orig_dtype`` lines (mixed
+# bf16/int8/int16 casts need the original dtype to restore exactly). The
+# "/"-free dunder name cannot collide with real leaves (flax param paths
+# always nest at least one module level; rollout leaves all nest under
+# obs/actions/carry0 or are known scalar-track names).
 _WIRE_CAST_MARKER = "__wire_cast__"
 
 

@@ -320,6 +320,12 @@ class ShmTransportServer:
         self._tel.gauge("transport/queue_depth")
         self._tel.counter("transport/frames_corrupt_total")
         self._tel.counter("transport/peers_quarantined")
+        # quantized experience plane (ISSUE 7) — pinned by
+        # check_telemetry_schema.py --require-wire
+        self._tel.counter("transport/rollout_bytes_total")
+        self._tel.counter("transport/rollout_raw_bytes_total")
+        self._tel.gauge("transport/rollout_compression_ratio").set(1.0)
+        self._rollout_totals = [0, 0]   # [wire bytes, raw bytes] consumed
 
     # -- rollout lane ------------------------------------------------------
 
@@ -519,14 +525,14 @@ class ShmTransportServer:
         return protos
 
     def consume_decoded(self, max_count: int, timeout: Optional[float] = None):
-        from dotaclient_tpu.transport.serialize import decode_rollout_bytes
+        """Zero-copy drain decoded; byte accounting shared with the socket
+        lane via :func:`serialize.decode_drained_payloads`."""
+        from dotaclient_tpu.transport.serialize import decode_drained_payloads
 
-        out = []
-        for p in self._drain(max_count, timeout):
-            try:
-                out.append(decode_rollout_bytes(p))
-            except Exception:
-                self.bad_payloads += 1
+        out, bad = decode_drained_payloads(
+            self._drain(max_count, timeout), self._tel, self._rollout_totals
+        )
+        self.bad_payloads += bad
         return out
 
     # -- weights lane ------------------------------------------------------

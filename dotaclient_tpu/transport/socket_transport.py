@@ -252,11 +252,19 @@ class TransportServer:
             "transport/conn_idle_drops",
             "transport/heartbeats_sent",
             "transport/reader_exits",
+            # quantized experience plane (ISSUE 7) — pinned by
+            # check_telemetry_schema.py --require-wire
+            "transport/rollout_bytes_total",
+            "transport/rollout_raw_bytes_total",
         ):
             self._tel.counter(name)
         self._tel.gauge("transport/fanout_lag_max")
         self._tel.gauge("transport/fanout_queue_depth")
         self._tel.gauge("transport/actors_connected")
+        # raw/wire byte ratio over everything consumed so far; 1.0 until
+        # the first frame (no data = no compression claim)
+        self._tel.gauge("transport/rollout_compression_ratio").set(1.0)
+        self._rollout_totals = [0, 0]   # [wire bytes, raw bytes] consumed
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="transport-accept", daemon=True
         )
@@ -551,17 +559,15 @@ class TransportServer:
         """Drain as decoded (meta, arrays) pairs via the native fast-path
         wire parser — the learner-ingest hot path (SURVEY.md §2.2 row 3).
         The arrays are zero-copy views into the wire payloads; the buffer's
-        staging lanes copy straight out of them (its only copy). Malformed
-        payloads (version-skewed actors, port scanners) are counted and
-        dropped — the disposable-actor failure model, SURVEY.md §5.3."""
-        from dotaclient_tpu.transport.serialize import decode_rollout_bytes
+        staging lanes copy straight out of them (its only copy). Decode
+        errors and the wire/raw byte accounting (ISSUE 7) live in the
+        shared :func:`serialize.decode_drained_payloads`."""
+        from dotaclient_tpu.transport.serialize import decode_drained_payloads
 
-        out = []
-        for p in self._drain(max_count, timeout):
-            try:
-                out.append(decode_rollout_bytes(p))
-            except Exception:
-                self.bad_payloads += 1
+        out, bad = decode_drained_payloads(
+            self._drain(max_count, timeout), self._tel, self._rollout_totals
+        )
+        self.bad_payloads += bad
         return out
 
     def publish_weights(self, weights: pb.ModelWeights) -> None:

@@ -68,6 +68,7 @@ ALLOWED_FUNCS: Dict[str, Set[str]] = {
         "__init__",
         "_matches_slot",
         "_payload_finite",      # admission door: host arrays only (ISSUE 6)
+        "_payload_in_bounds",   # admission door: host arrays only (ISSUE 7)
         "state_dict",
         "load_state_dict",
         "_publish_telemetry",
@@ -101,11 +102,21 @@ ALLOWED_FUNCS: Dict[str, Set[str]] = {
 # work. Only the named functions are scanned; the rest of each module is
 # out of this guard's scope.
 SCAN_ONLY_FUNCS: Dict[str, Set[str]] = {
+    # consume_decoded (ISSUE 7) feeds the buffer's consume-time upcast:
+    # it runs on the learner thread every ingest and its byte accounting
+    # must stay host-int arithmetic — a sync pattern there would serialize
+    # the whole ingest drain behind device work.
     "dotaclient_tpu/transport/socket_transport.py": {
-        "publish_weights", "_writer_loop",
+        "publish_weights", "_writer_loop", "consume_decoded",
     },
-    "dotaclient_tpu/transport/shm_transport.py": {"publish_weights"},
+    "dotaclient_tpu/transport/shm_transport.py": {
+        "publish_weights", "consume_decoded",
+    },
     "dotaclient_tpu/transport/queues.py": {"publish_weights"},
+    # The shared byte-accounting body both consume_decoded paths call
+    # (review round 3): the accounting itself lives here now, so the
+    # tripwire must follow it.
+    "dotaclient_tpu/transport/serialize.py": {"decode_drained_payloads"},
 }
 
 ANNOTATION = "host-sync-ok"

@@ -104,6 +104,17 @@ FAULT_KEYS = (
     "checkpoint/save_failures_total",   # degraded periodic saves
 )
 
+# Quantized experience plane (ISSUE 7). Validated with --require-wire
+# against a run that used the socket OR shm transport: both servers
+# eager-create the byte counters and the compression-ratio gauge at
+# construction (the gauge initializes to 1.0 — an f32 run deterministically
+# reports "no compression", never "no data").
+WIRE_KEYS = (
+    "transport/rollout_bytes_total",        # actual rollout wire bytes consumed
+    "transport/rollout_raw_bytes_total",    # what full-width would have cost
+    "transport/rollout_compression_ratio",  # raw / wire over the run
+)
+
 # Training health guardian (ISSUE 6). Validated with --require-health
 # against any health-enabled learner run's JSONL (health.enabled defaults
 # on): the HealthMonitor eager-creates every one of these at construction —
@@ -115,6 +126,22 @@ HEALTH_KEYS = (
     "health/rollbacks_total",           # last_good restores performed
     "health/last_good_step",            # newest health-verified save
     "buffer/stale_rejected_total",      # admission-control staleness drops
+)
+
+# Keys only an IN-PROCESS actor emits. A learner serving external actor
+# processes over socket/shm never runs its own collect loop, so its JSONL
+# legitimately lacks these — they are waived when the line union carries an
+# external-transport marker (both servers eager-create theirs at
+# construction, so detection is deterministic, not event-driven).
+IN_PROC_ACTOR_KEYS = (
+    "span/actor/collect/mean_s",
+    "span/actor/drain/mean_s",
+    "actor/frames_shipped",
+    "actor/rollouts_shipped",
+)
+EXTERNAL_TRANSPORT_MARKERS = (
+    "transport/actors_connected",       # socket server
+    "shm/ring_occupancy",               # shm server
 )
 
 
@@ -149,9 +176,12 @@ def validate_lines(
             elif v is not None and not isinstance(v, (int, float)):
                 errors.append(f"line {i}: scalar {k!r} is {type(v).__name__}")
         union.update(scalars)
-    missing = [
-        k for k in (*REQUIRED_KEYS, *extra_required) if k not in union
-    ]
+    required = (*REQUIRED_KEYS, *extra_required)
+    if any(m in union for m in EXTERNAL_TRANSPORT_MARKERS):
+        required = tuple(
+            k for k in required if k not in IN_PROC_ACTOR_KEYS
+        )
+    missing = [k for k in required if k not in union]
     if missing:
         errors.append(
             "required telemetry keys never emitted: " + ", ".join(missing)
@@ -208,6 +238,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         "them in async and sync-snapshots modes alike",
     )
     p.add_argument(
+        "--require-wire", action="store_true",
+        help="also require the quantized-experience-plane byte accounting "
+        "(ISSUE 7); valid against any --transport socket/shm run's JSONL — "
+        "both servers eager-create the counters and the ratio gauge",
+    )
+    p.add_argument(
         "--require-health", action="store_true",
         help="also require the training-health-guardian keys (ISSUE 6); "
         "valid against any learner run with health.enabled (the default) — "
@@ -223,6 +259,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         extra += FAULT_KEYS
     if args.require_snapshot:
         extra += SNAPSHOT_KEYS
+    if args.require_wire:
+        extra += WIRE_KEYS
     if args.require_health:
         extra += HEALTH_KEYS
 

@@ -1,0 +1,697 @@
+"""Quantized experience plane tests (ISSUE 7).
+
+Covers the rollout wire-cast discipline end-to-end: the name/dtype-driven
+cast plan and its pinned-f32 allowlist, marker round-trip parity across the
+python-proto codec, the native bytes codec, and the shm lane, the
+loud _MAX_TENSORS ceiling, the trajectory buffer's narrow store + on-device
+consume-time upcast, narrow-native finiteness admission (zero f32 copies),
+CRC/quarantine behavior on narrow frames, the wire telemetry tier, and a
+short narrow-vs-f32 learner parity run (slow)."""
+
+import dataclasses
+import importlib.util
+import json
+import os
+import tracemalloc
+
+import jax
+import numpy as np
+import pytest
+
+from dotaclient_tpu.config import RunConfig
+from dotaclient_tpu.transport import serialize as S
+from dotaclient_tpu.utils import telemetry
+
+try:
+    import ml_dtypes
+
+    BF16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    BF16 = None
+
+pytestmark = pytest.mark.skipif(BF16 is None, reason="ml_dtypes unavailable")
+
+
+def tiny_config(wire: str = "bfloat16") -> RunConfig:
+    # batch_rollouts/capacity stay multiples of 8: the test env forces 8
+    # host devices and batches shard over the data axis
+    cfg = RunConfig()
+    return dataclasses.replace(
+        cfg,
+        env=dataclasses.replace(cfg.env, n_envs=2, max_dota_time=30.0),
+        ppo=dataclasses.replace(cfg.ppo, rollout_len=4, batch_rollouts=8),
+        buffer=dataclasses.replace(
+            cfg.buffer, capacity_rollouts=16, min_fill=8
+        ),
+        transport=dataclasses.replace(
+            cfg.transport, rollout_wire_dtype=wire
+        ),
+        log_every=1000,
+        checkpoint_every=1000,
+    )
+
+
+def decoded_copies(cfg, row, n):
+    """n independently-decoded (meta, arrays) pairs of the same row."""
+    payload = bytes(
+        S.encode_rollout_bytes(row, **META, **wire_kwargs(cfg))
+    )
+    out = []
+    for i in range(n):
+        meta, arrays = S.decode_rollout_bytes(payload)
+        meta["rollout_id"] = i
+        out.append((meta, arrays))
+    return out
+
+
+def real_row(cfg: RunConfig, seed: int = 0, representable: bool = True):
+    """One rollout row with non-trivial values; with ``representable`` the
+    narrowable f32 leaves are pre-rounded to bf16 so the narrow wire is
+    exact and parity assertions can demand bit equality."""
+    from dotaclient_tpu.train.ppo import example_batch
+
+    rng = np.random.default_rng(seed)
+    row = jax.tree.map(
+        lambda x: np.array(x[0]), example_batch(cfg, batch=1)
+    )
+    flat = S.flatten_tree(row)
+    for name, arr in flat.items():
+        if arr.dtype == np.float32:
+            vals = rng.normal(size=arr.shape).astype(np.float32)
+            if representable and not S.rollout_leaf_pinned(name):
+                vals = vals.astype(BF16).astype(np.float32)
+            flat[name] = vals
+        elif arr.dtype == np.int32:
+            flat[name] = rng.integers(0, 3, size=arr.shape).astype(np.int32)
+    return S.unflatten_tree(flat)
+
+
+def wire_kwargs(cfg: RunConfig):
+    return dict(
+        wire_dtype=cfg.transport.rollout_wire_dtype,
+        int_bounds=S.rollout_int_bounds(cfg),
+    )
+
+
+META = dict(model_version=0, env_id=0, rollout_id=0, length=4,
+            total_reward=1.0)
+
+
+def assert_trees_equal(a, b, exact_dtypes=True):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        x, y = np.asarray(x), np.asarray(y)
+        if exact_dtypes:
+            assert x.dtype == y.dtype
+        np.testing.assert_array_equal(x, y)
+
+
+class TestCastPlan:
+    def test_pinned_leaves_never_narrow(self):
+        cfg = tiny_config()
+        specs = {
+            "behavior_logp": np.float32, "rewards": np.float32,
+            "dones": np.float32, "values": np.float32,
+            "carry0/0": np.float32, "carry0/1": np.float32,
+            "obs/units": np.float32,
+        }
+        plan = S.rollout_cast_plan(
+            specs, "bfloat16", S.rollout_int_bounds(cfg)
+        )
+        assert set(plan) == {"obs/units"}
+        assert plan["obs/units"] == BF16
+
+    def test_int_bounds_drive_exact_narrowing(self):
+        cfg = tiny_config()
+        bounds = S.rollout_int_bounds(cfg)
+        specs = {
+            "actions/move_x": np.int32,       # bound 8 → int8
+            "obs/hero_id": np.int32,          # bound 31 → int8
+            "obs/unit_handles": np.int32,     # bound 32767 → int16
+            "obs/unbounded": np.int32,        # no bound → untouched
+        }
+        plan = S.rollout_cast_plan(specs, "bfloat16", bounds)
+        assert plan["actions/move_x"] == np.int8
+        assert plan["obs/hero_id"] == np.int8
+        assert plan["obs/unit_handles"] == np.int16
+        assert "obs/unbounded" not in plan
+
+    def test_f32_wire_is_empty_plan(self):
+        assert S.rollout_cast_plan({"obs/units": np.float32}, "float32") == {}
+
+    def test_unknown_wire_dtype_raises(self):
+        with pytest.raises(ValueError, match="rollout_wire_dtype"):
+            S.rollout_cast_plan({}, "float16")
+
+    def test_out_of_bound_int_fails_loudly(self):
+        """The int bound is a config promise — a value that breaks it must
+        raise at encode, never wrap into a corrupt stream."""
+        arrays = {"actions": {"move_x": np.array([300], np.int32)},
+                  "rewards": np.zeros((1,), np.float32)}
+        with pytest.raises(ValueError, match="move_x"):
+            S.encode_rollout_bytes(
+                arrays, 0, 0, 0, 1, 0.0, wire_dtype="bfloat16",
+                int_bounds={"actions/move_x": 8},
+            )
+
+
+class TestMarkerRoundTrip:
+    def test_native_bytes_parity_with_f32_path(self):
+        """encode→wire→decode→upcast over the native codec exactly equals
+        the f32 path for bf16-representable inputs."""
+        cfg = tiny_config()
+        row = real_row(cfg)
+        b32 = bytes(S.encode_rollout_bytes(row, **META))
+        bnar = bytes(S.encode_rollout_bytes(row, **META, **wire_kwargs(cfg)))
+        assert len(bnar) < len(b32)
+        m32, a32 = S.decode_rollout_bytes(b32)
+        mn, an = S.decode_rollout_bytes(bnar, upcast=True)
+        assert "wire_cast" not in m32
+        assert mn["wire_cast"]
+        assert_trees_equal(an, a32)
+
+    def test_proto_codec_parity(self):
+        cfg = tiny_config()
+        row = real_row(cfg)
+        r = S.encode_rollout(row, **META, **wire_kwargs(cfg))
+        mn, an = S.decode_rollout(r, upcast=True)
+        _, a32 = S.decode_rollout(S.encode_rollout(row, **META))
+        assert mn["wire_cast"]
+        assert_trees_equal(an, a32)
+
+    def test_cross_codec_parity(self):
+        """A proto-encoded narrow payload decodes identically through the
+        native parser (marker intercepted by name on both)."""
+        cfg = tiny_config()
+        row = real_row(cfg)
+        payload = S.encode_rollout(
+            row, **META, **wire_kwargs(cfg)
+        ).SerializeToString()
+        m_native, a_native = S.decode_rollout_bytes(payload, upcast=True)
+        m_proto, a_proto = S.decode_rollout_bytes(
+            payload, native=False, upcast=True
+        )
+        assert m_native["wire_cast"] == m_proto["wire_cast"]
+        assert_trees_equal(a_native, a_proto)
+
+    def test_pinned_leaves_byte_identical(self):
+        """Pinned f32 leaves cross a narrow wire byte-for-byte — even for
+        values a bf16 cast would round."""
+        cfg = tiny_config()
+        row = real_row(cfg, representable=False)
+        payload = bytes(
+            S.encode_rollout_bytes(row, **META, **wire_kwargs(cfg))
+        )
+        _, decoded = S.decode_rollout_bytes(payload)
+        for name in ("behavior_logp", "rewards", "dones"):
+            got, want = decoded[name], row[name]
+            assert got.dtype == np.float32
+            assert got.tobytes() == want.tobytes()
+        for got, want in zip(
+            jax.tree.leaves(decoded["carry0"]), jax.tree.leaves(row["carry0"])
+        ):
+            assert np.asarray(got).dtype == np.float32
+            assert np.asarray(got).tobytes() == np.asarray(want).tobytes()
+
+    def test_meta_accounting(self):
+        cfg = tiny_config()
+        row = real_row(cfg)
+        payload = bytes(
+            S.encode_rollout_bytes(row, **META, **wire_kwargs(cfg))
+        )
+        meta, _ = S.decode_rollout_bytes(payload)
+        assert meta["wire_bytes"] == len(payload)
+        assert meta["raw_bytes"] > meta["wire_bytes"]
+        # raw_bytes is EXACT: what this frame actually costs full-width
+        # (no marker entry; per-leaf framing re-costed at the original
+        # dtype) — an f32 encode of the same row, byte for byte, from
+        # both codec paths
+        f32_payload = bytes(S.encode_rollout_bytes(row, **META))
+        assert meta["raw_bytes"] == len(f32_payload)
+        meta_pb, _ = S.decode_rollout_bytes(payload, native=False)
+        assert meta_pb["raw_bytes"] == len(f32_payload)
+        # every narrowed leaf names its true original dtype
+        assert meta["wire_cast"]["obs/units"] == "float32"
+        assert meta["wire_cast"]["obs/unit_handles"] == "int32"
+        assert all(
+            not S.rollout_leaf_pinned(n) for n in meta["wire_cast"]
+        )
+
+    def test_f32_wire_unchanged(self):
+        """Knob off: no marker, identical bytes to the pre-ISSUE-7 codec."""
+        cfg = tiny_config("float32")
+        row = real_row(cfg)
+        assert wire_kwargs(cfg)["wire_dtype"] == "float32"
+        b_plain = bytes(S.encode_rollout_bytes(row, **META))
+        b_kw = bytes(S.encode_rollout_bytes(row, **META, **wire_kwargs(cfg)))
+        assert b_plain == b_kw
+
+
+class TestDrainedPayloadAccounting:
+    def test_zero_length_payload_cannot_zero_divide_the_gauge(self):
+        """A zero-byte payload parses as an empty proto (wire_bytes =
+        raw_bytes = 0); on a server whose totals are still zero the
+        compression gauge must stay at its floor, not ZeroDivisionError
+        out of the learner's ingest drain."""
+        reg = telemetry.get_registry()
+        totals = [0, 0]
+        out, bad = S.decode_drained_payloads([b""], reg, totals)
+        assert bad == 0 and len(out) == 1
+        assert totals == [0, 0]
+        # and real payloads afterwards resume normal accounting
+        cfg = tiny_config()
+        payload = bytes(
+            S.encode_rollout_bytes(
+                real_row(cfg), **META, **wire_kwargs(cfg)
+            )
+        )
+        out, bad = S.decode_drained_payloads([payload], reg, totals)
+        assert bad == 0 and totals[0] > 0 and totals[1] > totals[0]
+
+
+class TestTooManyTensors:
+    def _big_tree(self, n):
+        return {"obs": {f"x{i}": np.zeros((2,), np.float32)
+                        for i in range(n)}}
+
+    def test_encode_raises_with_count(self):
+        with pytest.raises(ValueError, match="70"):
+            S.encode_rollout_bytes(self._big_tree(70), 0, 0, 0, 1, 0.0)
+        with pytest.raises(ValueError, match="70"):
+            S.encode_rollout(self._big_tree(70), 0, 0, 0, 1, 0.0)
+
+    def test_decode_raises_with_count(self):
+        from dotaclient_tpu.protos import dota_pb2 as pb
+
+        r = pb.Rollout(model_version=0)
+        for i in range(70):
+            r.arrays[f"x{i}"].CopyFrom(
+                S.tensor_to_proto(np.zeros((2,), np.float32))
+            )
+        payload = r.SerializeToString()
+        with pytest.raises(ValueError, match="70"):
+            S.decode_rollout_bytes(payload)
+        with pytest.raises(ValueError, match="70"):
+            S.decode_rollout_bytes(payload, native=False)
+
+    def test_marker_counts_toward_ceiling(self):
+        tree = self._big_tree(S._MAX_TENSORS)
+        # f32: exactly at the ceiling — fine
+        S.encode_rollout_bytes(tree, 0, 0, 0, 1, 0.0)
+        # narrow: the marker entry tips it over — loud
+        with pytest.raises(ValueError, match=str(S._MAX_TENSORS + 1)):
+            S.encode_rollout_bytes(
+                tree, 0, 0, 0, 1, 0.0, wire_dtype="bfloat16"
+            )
+
+
+def make_buffer(cfg):
+    from dotaclient_tpu.buffer.trajectory_buffer import TrajectoryBuffer
+    from dotaclient_tpu.parallel import make_mesh
+
+    return TrajectoryBuffer(cfg, make_mesh(cfg.mesh))
+
+
+class TestNarrowBuffer:
+    def test_store_is_narrow_and_take_is_f32(self):
+        cfg = tiny_config()
+        buf = make_buffer(cfg)
+        stored = S.flatten_tree(jax.tree.map(np.asarray, buf._store))
+        assert stored["obs/units"].dtype == BF16
+        assert stored["actions/move_x"].dtype == np.int8
+        assert stored["obs/unit_handles"].dtype == np.int16
+        assert stored["behavior_logp"].dtype == np.float32   # pinned
+        row = real_row(cfg)
+        assert buf.add(decoded_copies(cfg, row, 8), 0) == 8
+        batch = buf.take(batch_size=8, current_version=0)
+        flat = S.flatten_tree(jax.tree.map(np.asarray, batch))
+        assert flat["obs/units"].dtype == np.float32
+        assert flat["actions/move_x"].dtype == np.int32
+        assert flat["obs/unit_handles"].dtype == np.int32
+
+    def test_upcast_bit_identical_to_f32_path(self):
+        """The consume-time upcast makes the narrow ring's batch EQUAL the
+        f32 ring's batch for bf16-representable experience — the train
+        step cannot tell the wire dtype was ever narrow."""
+        row = real_row(tiny_config())
+        batches = {}
+        for wire in ("float32", "bfloat16"):
+            cfg = tiny_config(wire)
+            cfg = dataclasses.replace(
+                cfg,
+                buffer=dataclasses.replace(
+                    cfg.buffer, capacity_rollouts=8, min_fill=8
+                ),
+            )
+            buf = make_buffer(cfg)
+            assert buf.add(decoded_copies(cfg, row, 8), 0) == 8
+            batches[wire] = jax.tree.map(
+                np.asarray, buf.take(batch_size=8, current_version=0)
+            )
+        assert_trees_equal(batches["bfloat16"], batches["float32"])
+
+    def test_full_width_payload_admitted_to_narrow_ring(self):
+        """An in-proc actor (or an f32-knob fleet member) ships full-width
+        rows; the narrow ring quantizes at the staging copy instead of
+        skew-dropping them."""
+        cfg = tiny_config()
+        buf = make_buffer(cfg)
+        assert buf.add([(dict(META), real_row(cfg))], 0) == 1
+        assert buf.dropped_skew == 0
+
+    def test_full_width_out_of_bounds_rejected_at_narrow_ring(self):
+        """A full-width int row whose values exceed the narrow ring's
+        declared bounds must be REJECTED at the door, not silently
+        wrapped by the staging/scatter cast (the mirror of the encode
+        path's exactness guard — mixed fleets fail loudly too)."""
+        cfg = tiny_config()
+        buf = make_buffer(cfg)
+        row = real_row(cfg)
+        flat = S.flatten_tree(row)
+        # int8-narrowed action leaf: 300 wraps to 44 under a silent cast
+        bad = dict(flat)
+        name = next(
+            n for n, d in buf._wire_plan.items() if np.dtype(d) == np.int8
+        )
+        arr = np.array(bad[name])
+        arr.flat[0] = 300
+        bad[name] = arr
+        assert buf.add([(dict(META), S.unflatten_tree(bad))], 0) == 0
+        assert buf.dropped_bounds == 1
+        assert buf.dropped_skew == 0
+        # the same row at legal values is admitted
+        assert buf.add([(dict(META), row)], 0) == 1
+
+    def test_narrow_payload_admitted_to_f32_ring(self):
+        cfg_f32 = tiny_config("float32")
+        cfg_n = tiny_config()
+        buf = make_buffer(cfg_f32)
+        payload = bytes(
+            S.encode_rollout_bytes(
+                real_row(cfg_n), **META, **wire_kwargs(cfg_n)
+            )
+        )
+        meta, arrays = S.decode_rollout_bytes(payload)
+        assert buf.add([(meta, arrays)], 0) == 1
+        assert buf.dropped_skew == 0
+
+    def test_genuine_skew_still_drops(self):
+        cfg = tiny_config()
+        buf = make_buffer(cfg)
+        row = real_row(cfg)
+        bad = dict(row)
+        bad["rewards"] = row["rewards"].astype(np.float64)   # wrong width
+        assert buf.add([(dict(META), bad)], 0) == 0
+        assert buf.dropped_skew == 1
+        short = dict(row)
+        short["rewards"] = row["rewards"][:-1]               # wrong shape
+        assert buf.add([(dict(META), short)], 0) == 0
+        assert buf.dropped_skew == 2
+
+    def test_snapshot_restores_across_wire_dtypes(self):
+        cfg = tiny_config()
+        buf = make_buffer(cfg)
+        assert buf.add([(dict(META), real_row(cfg))], 0) == 1
+        state = buf.state_dict()
+        buf_f32 = make_buffer(tiny_config("float32"))
+        buf_f32.load_state_dict(state)
+        stored = S.flatten_tree(jax.tree.map(np.asarray, buf_f32._store))
+        assert stored["obs/units"].dtype == np.float32
+        assert buf_f32.size == 1
+
+    def test_restore_frees_out_of_range_slots_instead_of_wrapping(self):
+        """The reverse restore (f32 snapshot → narrow ring) runs the same
+        bound guard as the ingest door: an int slot whose values exceed
+        the narrow bounds is freed and counted, never wrapped by the
+        storage-width cast."""
+        narrow = make_buffer(tiny_config())
+        buf = make_buffer(cfg_f32 := tiny_config("float32"))
+        assert buf.add([(dict(META), real_row(cfg_f32))], 0) == 1
+        bad = S.flatten_tree(real_row(cfg_f32, seed=1))
+        name = next(
+            n for n, d in narrow._wire_plan.items()
+            if np.dtype(d) == np.int8
+        )
+        arr = np.array(bad[name])
+        arr.flat[0] = 300   # wraps to 44 under a silent int8 cast
+        bad[name] = arr
+        # the f32 ring has no guards: the oversized row is admitted there
+        assert buf.add(
+            [(dict(dict(META), rollout_id=1), S.unflatten_tree(bad))], 0
+        ) == 1
+        narrow.load_state_dict(buf.state_dict())
+        assert narrow.size == 1           # only the in-bounds slot survives
+        assert narrow.dropped_bounds == 1
+        # the surviving slot's int leaf round-trips exactly
+        stored = S.flatten_tree(jax.tree.map(np.asarray, narrow._store))
+        good_flat = S.flatten_tree(real_row(cfg_f32))
+        slot = list(narrow._order)[0]
+        np.testing.assert_array_equal(
+            stored[name][slot], good_flat[name].astype(np.int8)
+        )
+
+
+@pytest.mark.slow    # DeviceActor's scan compile alone is ~30s on this host
+class TestDeviceActorNarrowChunks:
+    def test_collect_emits_narrow_chunks_fused_path_untouched(self):
+        from dotaclient_tpu.actor.device_rollout import DeviceActor
+        from dotaclient_tpu.models import init_params, make_policy
+
+        cfg = tiny_config()
+        policy = make_policy(cfg.model, cfg.obs, cfg.actions)
+        params = init_params(policy, jax.random.PRNGKey(0))
+        actor = DeviceActor(cfg, policy, seed=0)
+        chunk, _ = actor.collect(params)
+        flat = S.flatten_tree(jax.tree.map(np.asarray, chunk))
+        assert flat["obs/units"].dtype == BF16
+        assert flat["actions/move_x"].dtype == np.int8
+        assert flat["behavior_logp"].dtype == np.float32   # pinned
+        # fused mode consumes _rollout_impl directly: full width there
+        _, raw_chunk, _ = actor._rollout_impl(
+            params, actor.state, params
+        )
+        raw = S.flatten_tree(raw_chunk)
+        assert raw["obs/units"].dtype == np.dtype("float32")
+
+
+class TestNarrowFiniteness:
+    def test_bf16_nan_rejected_at_the_door(self):
+        cfg = tiny_config()
+        buf = make_buffer(cfg)
+        payload = bytes(
+            S.encode_rollout_bytes(
+                real_row(cfg), **META, **wire_kwargs(cfg)
+            )
+        )
+        meta, arrays = S.decode_rollout_bytes(payload)
+        units = np.array(arrays["obs"]["units"])   # views are read-only
+        units[0, 0, 0] = np.nan                    # a bf16 NaN is still NaN
+        arrays = dict(arrays)
+        arrays["obs"] = dict(arrays["obs"])
+        arrays["obs"]["units"] = units
+        assert buf.add([(meta, arrays)], 0) == 0
+        assert buf.dropped_nonfinite == 1
+
+    def test_finiteness_scan_never_upcasts(self):
+        """The admission scan runs natively on bf16 rows: peak transient
+        allocation stays at the bool-result scale (~0.5× the leaf bytes) —
+        an f32 upcast copy would cost 2× the leaf bytes and fail this."""
+        cfg = tiny_config()
+        buf = make_buffer(cfg)
+        n = 1 << 20
+        leaf = np.zeros((n,), BF16)
+        arrays = {"obs": {"units": leaf}}
+        tracemalloc.start()
+        try:
+            base = tracemalloc.get_traced_memory()[0]
+            assert buf._payload_finite(arrays)
+            peak = tracemalloc.get_traced_memory()[1]
+        finally:
+            tracemalloc.stop()
+        assert peak - base < leaf.nbytes   # bool result ≈ 0.5×, f32 copy = 2×
+
+
+class TestShmLaneNarrow:
+    def _lane(self, tag, **kw):
+        from dotaclient_tpu.transport import ShmTransport, ShmTransportServer
+
+        name = f"t-quant-{os.getpid()}-{tag}"
+        server = ShmTransportServer(
+            name=name, slots=1, ring_bytes=1 << 20, weights_bytes=1 << 20,
+            **kw,
+        )
+        return server, ShmTransport(name, slots=1)
+
+    def test_narrow_frames_roundtrip_and_count(self):
+        cfg = tiny_config()
+        row = real_row(cfg)
+        reg = telemetry.get_registry()
+        server, actor = self._lane("narrow")
+        try:
+            wire0 = reg.counter("transport/rollout_bytes_total").value
+            raw0 = reg.counter("transport/rollout_raw_bytes_total").value
+            for i in range(3):
+                meta = dict(META, rollout_id=i)
+                assert actor.publish_rollout_bytes(
+                    S.encode_rollout_bytes(row, **meta, **wire_kwargs(cfg))
+                )
+            got = server.consume_decoded(16, timeout=1.0)
+            assert [m["rollout_id"] for m, _ in got] == [0, 1, 2]
+            flat = S.flatten_tree(got[0][1])
+            assert flat["obs/units"].dtype == BF16
+            wire = reg.counter("transport/rollout_bytes_total").value - wire0
+            raw = reg.counter("transport/rollout_raw_bytes_total").value - raw0
+            assert raw > wire > 0
+            assert (
+                reg.gauge("transport/rollout_compression_ratio").value > 1.3
+            )
+        finally:
+            actor.close()
+            server.close()
+
+    def test_crc_quarantine_unchanged_on_narrow_frames(self):
+        """The integrity layer is payload-agnostic: a bit-flipped narrow
+        frame drops + counts exactly like an f32 one, and a poison streak
+        still quarantines the slot."""
+        from dotaclient_tpu.utils import faults
+
+        cfg = tiny_config()
+        row = real_row(cfg)
+        reg = telemetry.get_registry()
+        before = reg.counter("transport/frames_corrupt_total").value
+        faults.configure("transport.corrupt_frame@2")
+        server, actor = self._lane("crc")
+        try:
+            for i in range(4):
+                assert actor.publish_rollout_bytes(
+                    S.encode_rollout_bytes(
+                        row, **dict(META, rollout_id=i), **wire_kwargs(cfg)
+                    )
+                )
+            got = server.consume_decoded(16, timeout=1.0)
+            assert [m["rollout_id"] for m, _ in got] == [0, 2, 3]
+            assert (
+                reg.counter("transport/frames_corrupt_total").value
+                == before + 1
+            )
+        finally:
+            faults.configure(None)
+            actor.close()
+            server.close()
+
+
+class TestWireTelemetryTier:
+    @pytest.fixture()
+    def checker(self):
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "check_telemetry_schema_q",
+            os.path.join(root, "scripts", "check_telemetry_schema.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_wire_keys_required_only_on_request(self, checker):
+        base = {k: 1.0 for k in checker.REQUIRED_KEYS}
+        for k in list(base):
+            if k.startswith("span/"):
+                root = k.rsplit("/", 1)[0]
+                for leaf in checker.TIMER_LEAVES:
+                    base[f"{root}/{leaf}"] = 1.0
+        line = json.dumps({"ts": 1.0, "step": 0, "scalars": base})
+        assert checker.validate_lines([line]) == []
+        errors = checker.validate_lines(
+            [line], extra_required=checker.WIRE_KEYS
+        )
+        assert any("rollout_compression_ratio" in e for e in errors)
+        full = dict(base, **{k: 0.0 for k in checker.WIRE_KEYS})
+        line2 = json.dumps({"ts": 1.0, "step": 0, "scalars": full})
+        assert checker.validate_lines(
+            [line2], extra_required=checker.WIRE_KEYS
+        ) == []
+
+    def test_external_transport_run_waives_in_proc_actor_keys(self, checker):
+        # a socket/shm learner's JSONL has no in-proc actor spans — the
+        # server marker key waives exactly those, nothing else
+        scalars = {
+            k: 1.0
+            for k in checker.REQUIRED_KEYS
+            if k not in checker.IN_PROC_ACTOR_KEYS
+        }
+        for k in list(scalars):
+            if k.startswith("span/"):
+                root = k.rsplit("/", 1)[0]
+                for leaf in checker.TIMER_LEAVES:
+                    scalars[f"{root}/{leaf}"] = 1.0
+        scalars.update({k: 0.0 for k in checker.WIRE_KEYS})
+        no_marker = json.dumps({"ts": 1.0, "step": 0, "scalars": scalars})
+        errors = checker.validate_lines(
+            [no_marker], extra_required=checker.WIRE_KEYS
+        )
+        assert any("frames_shipped" in e for e in errors)
+        scalars["transport/actors_connected"] = 1.0
+        with_marker = json.dumps({"ts": 1.0, "step": 0, "scalars": scalars})
+        assert checker.validate_lines(
+            [with_marker], extra_required=checker.WIRE_KEYS
+        ) == []
+
+    def test_both_servers_eager_create_wire_keys(self, checker):
+        from dotaclient_tpu.transport import ShmTransportServer, TransportServer
+
+        reg = telemetry.get_registry()
+        srv = TransportServer(port=0)
+        try:
+            snap = reg.snapshot()
+            for k in checker.WIRE_KEYS:
+                assert k in snap, k
+            assert snap["transport/rollout_compression_ratio"] >= 1.0
+        finally:
+            srv.close()
+        shm = ShmTransportServer(
+            name=f"t-quant-{os.getpid()}-tier", slots=1,
+            ring_bytes=1 << 16, weights_bytes=1 << 20,
+        )
+        try:
+            snap = reg.snapshot()
+            for k in checker.WIRE_KEYS:
+                assert k in snap, k
+        finally:
+            shm.close()
+
+
+@pytest.mark.slow
+class TestLearnerParity:
+    def test_short_run_losses_agree_within_bf16_tolerance(self):
+        """End-to-end: two vec-actor learners, identical seeds, narrow vs
+        f32 experience plane. The first consumed batches differ only by
+        the ring's bf16 quantization of observations, so losses must agree
+        to bf16 tolerance (the trajectories decouple slowly as the
+        quantized obs feed back through updates — keep the run short)."""
+        from dotaclient_tpu.config import LearnerConfig
+        from dotaclient_tpu.train.learner import Learner
+
+        losses = {}
+        for wire in ("float32", "bfloat16"):
+            cfg = dataclasses.replace(
+                tiny_config(wire),
+                ppo=dataclasses.replace(
+                    tiny_config().ppo, rollout_len=8, batch_rollouts=8
+                ),
+                buffer=dataclasses.replace(
+                    tiny_config().buffer, capacity_rollouts=32, min_fill=8
+                ),
+                # sync snapshots + per-step logging: the returned metrics
+                # deterministically carry the LAST step's loss
+                learner=LearnerConfig(async_snapshots=False),
+                log_every=1,
+            )
+            learner = Learner(cfg, actor="vec", seed=3)
+            stats = learner.train(2)
+            losses[wire] = stats["loss"]
+        assert np.isfinite(losses["float32"])
+        assert np.isfinite(losses["bfloat16"])
+        assert losses["bfloat16"] == pytest.approx(
+            losses["float32"], rel=0.05, abs=5e-3
+        )
