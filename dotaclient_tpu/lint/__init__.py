@@ -1,0 +1,46 @@
+"""graftlint: multi-pass static analysis for the repo's disciplines.
+
+``python -m dotaclient_tpu.lint`` runs every pass; see ``core.py`` for
+the framework and docs/ARCHITECTURE.md "Static analysis" for the rule
+catalog, the ``# lint-ok: <rule>(<why>)`` waiver, and when to baseline.
+
+Import-light by design (stdlib only — no jax/numpy): the tier-1 wrapper
+(tests/test_lint.py) runs the full lint in-process on every test run.
+"""
+
+from dotaclient_tpu.lint.config_drift import ConfigCliDriftRule
+from dotaclient_tpu.lint.core import (
+    DEFAULT_BASELINE,
+    Diagnostic,
+    FileCtx,
+    LintResult,
+    Rule,
+    fingerprint,
+    load_baseline,
+    run_rules,
+)
+from dotaclient_tpu.lint.donation import UseAfterDonateRule
+from dotaclient_tpu.lint.host_sync import HostSyncRule
+from dotaclient_tpu.lint.ownership import ThreadOwnershipRule
+from dotaclient_tpu.lint.telemetry_drift import TelemetryDriftRule
+
+# registration order = report order: cheap/precise first
+ALL_RULES = (
+    HostSyncRule,
+    UseAfterDonateRule,
+    ThreadOwnershipRule,
+    TelemetryDriftRule,
+    ConfigCliDriftRule,
+)
+
+__all__ = [
+    "ALL_RULES",
+    "DEFAULT_BASELINE",
+    "Diagnostic",
+    "FileCtx",
+    "LintResult",
+    "Rule",
+    "fingerprint",
+    "load_baseline",
+    "run_rules",
+]

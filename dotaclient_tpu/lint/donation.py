@@ -1,0 +1,314 @@
+"""use-after-donate pass: donated XLA buffers are dead after dispatch.
+
+``jax.jit(..., donate_argnums=...)`` hands the argument's device buffers
+to XLA for in-place reuse: after the call dispatches, the Python reference
+still exists but the buffers are garbage-in-waiting. On TPU a read is
+silent corruption; on the CPU sandbox (no real donation) it *works*, which
+is exactly why no test catches it — the classic "passed CI, corrupted the
+pod" class. The fused epoch step, the plain train step, and the buffer's
+ingest scatter all donate (``train/ppo.py``, ``buffer/trajectory_buffer.
+py``), so the learner is one careless ``state.params`` read away.
+
+The pass is a two-phase AST analysis over the whole package:
+
+1. **Registry build.** Every module is scanned for donating callables:
+   direct ``jax.jit(...)/pjit(...)`` calls carrying ``donate_argnums``
+   (literal positions), and *factories* — module-level functions that
+   return such a jit (``make_train_step`` → donates arg 0). Assignments
+   ``self.step = jax.jit(..., donate_argnums=(0,))`` or
+   ``self.step = make_train_step(...)`` then mark the dotted target as a
+   donating callable within that module.
+2. **Call-site scan.** For each call to a donating callable, the argument
+   at each donated position (when it is a plain ``name``/``self.x.y``
+   chain) is *tainted* from the end of the enclosing statement until the
+   first statement that rebinds it (or a prefix of it). Any load of the
+   tainted name — or a longer chain rooted at it, like ``self.state.
+   params`` after ``self.state`` was donated — inside that window flags.
+   The idiomatic rebind-in-the-same-statement
+   (``self.state, m = self.step(self.state, batch)``) is recognized and
+   never flags.
+
+Known limits (this is a tripwire, not a prover): the window is textual
+within one function, so a loop that donates without rebinding only flags
+reads *after* the call line, and aliasing through a second variable is
+invisible. Both are fine — the discipline the pass enforces is "rebind or
+copy, visibly", and every violation of *that* is caught. Waive a
+deliberate read with ``# lint-ok: use-after-donate(<why>)``.
+
+Donation specs the pass cannot position-track — ``donate_argnames``, or a
+``donate_argnums`` that is not a literal int/tuple (``donate_argnums=
+DONATE``) — are reported once at the definition site: the pass would
+otherwise be silently blind to every use of that callable, which is worse
+than the friction of a literal tuple or a waived definition.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from dotaclient_tpu.lint.core import (
+    Diagnostic,
+    FileCtx,
+    Rule,
+    assign_targets,
+    dotted_name,
+    package_py_files,
+)
+
+
+# sentinel: the call donates, but the positions are not statically known
+# (donate_argnames, or a non-literal donate_argnums expression) — such a
+# definition gets its own diagnostic instead of silent taint blindness
+UNTRACKABLE = "untrackable"
+
+
+def _donated_positions(call: ast.Call):
+    """Literal donate_argnums of a jit/pjit call; () for a jit without
+    donation; :data:`UNTRACKABLE` when it donates but the positions are
+    not literal; None when the node is not a jit/pjit call at all."""
+    fn = call.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else None
+    )
+    if name not in ("jit", "pjit"):
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = []
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                        out.append(e.value)
+                    else:
+                        return UNTRACKABLE  # mixed/non-literal element
+                return tuple(out)
+            return UNTRACKABLE  # name/expression spec
+        if kw.arg == "donate_argnames":
+            return UNTRACKABLE
+    return ()
+
+
+def _donating_call_spec(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    """Donated positions when ``node`` is a jit/pjit call WITH literal,
+    trackable donation (UNTRACKABLE specs report separately)."""
+    if not isinstance(node, ast.Call):
+        return None
+    pos = _donated_positions(node)
+    if pos and pos is not UNTRACKABLE:
+        return pos
+    return None
+
+
+def _untrackable_donation(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and _donated_positions(node) is UNTRACKABLE
+    )
+
+
+def build_factory_registry(
+    files: Dict[str, FileCtx]
+) -> Dict[str, Tuple[int, ...]]:
+    """Module-level functions (by bare name, package-wide) that return a
+    donating jit — directly or via a local variable. Conservative: a
+    factory with ANY donating return donates."""
+    registry: Dict[str, Tuple[int, ...]] = {}
+    for ctx in files.values():
+        if ctx.tree is None:
+            continue
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            local_donating: Dict[str, Tuple[int, ...]] = {}
+            returns_spec: Optional[Tuple[int, ...]] = None
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign):
+                    spec = _donating_call_spec(sub.value)
+                    if spec:
+                        for t in assign_targets(sub):
+                            local_donating[t] = spec
+                elif isinstance(sub, ast.Return) and sub.value is not None:
+                    spec = _donating_call_spec(sub.value)
+                    if spec is None:
+                        name = dotted_name(sub.value)
+                        spec = local_donating.get(name) if name else None
+                    if spec:
+                        returns_spec = spec
+            if returns_spec:
+                registry[node.name] = returns_spec
+    return registry
+
+
+class _FuncAnalysis:
+    """Taint analysis for one function body."""
+
+    def __init__(
+        self,
+        func: ast.AST,
+        donating: Dict[str, Tuple[int, ...]],
+        rel: str,
+        rule_id: str,
+    ) -> None:
+        self.func = func
+        self.donating = donating
+        self.rel = rel
+        self.rule_id = rule_id
+
+    def run(self) -> List[Diagnostic]:
+        # statement list in source order, with each statement's bound names
+        stmts: List[ast.stmt] = [
+            n for n in ast.walk(self.func) if isinstance(n, ast.stmt)
+        ]
+        stmts.sort(key=lambda s: (s.lineno, s.col_offset))
+        out: List[Diagnostic] = []
+        for stmt in stmts:
+            for call in ast.walk(stmt):
+                if not isinstance(call, ast.Call):
+                    continue
+                callee = dotted_name(call.func)
+                spec = self.donating.get(callee) if callee else None
+                if not spec:
+                    continue
+                rebound = set(assign_targets(stmt))
+                for pos in spec:
+                    if pos >= len(call.args):
+                        continue
+                    donated = dotted_name(call.args[pos])
+                    if donated is None or donated in rebound:
+                        continue  # rebind-in-statement: the idiom, safe
+                    out.extend(
+                        self._taint_window(stmt, stmts, callee, donated)
+                    )
+        return out
+
+    def _taint_window(
+        self,
+        call_stmt: ast.stmt,
+        stmts: List[ast.stmt],
+        callee: str,
+        donated: str,
+    ) -> List[Diagnostic]:
+        start = getattr(call_stmt, "end_lineno", call_stmt.lineno)
+        # first later statement that rebinds the donated name or a prefix
+        # of it (rebinding `self.state` revives `self.state.params`)
+        end = None
+        prefixes = _prefixes(donated)
+        for stmt in stmts:
+            if stmt.lineno <= start:
+                continue
+            if any(t in prefixes for t in assign_targets(stmt)):
+                end = stmt.lineno
+                break
+        out: List[Diagnostic] = []
+        seen_lines: Set[int] = set()
+        for node in ast.walk(self.func):
+            if not isinstance(node, (ast.Name, ast.Attribute)):
+                continue
+            if not isinstance(getattr(node, "ctx", None), ast.Load):
+                continue
+            name = dotted_name(node)
+            if name is None:
+                continue
+            if name != donated and not name.startswith(donated + "."):
+                continue
+            line = node.lineno
+            if line <= start or (end is not None and line >= end):
+                continue
+            if line in seen_lines:
+                continue
+            seen_lines.add(line)
+            out.append(
+                Diagnostic(
+                    self.rel,
+                    line,
+                    self.rule_id,
+                    f"read of {name!r} after it was donated to "
+                    f"{callee!r} (line {call_stmt.lineno}) — donated "
+                    f"buffers are invalid once the call dispatches "
+                    f"(silent corruption on TPU, invisible on CPU); "
+                    f"rebind the result, reorder the read, or copy "
+                    f"before donating",
+                    context=donated,
+                )
+            )
+        return out
+
+
+def _prefixes(name: str) -> Set[str]:
+    """{"self", "self.state"} for "self.state" — rebinding any of these
+    revives the donated name."""
+    parts = name.split(".")
+    return {".".join(parts[: i + 1]) for i in range(len(parts))}
+
+
+def analyze_module(
+    ctx: FileCtx,
+    factories: Dict[str, Tuple[int, ...]],
+    rule_id: str = "use-after-donate",
+) -> List[Diagnostic]:
+    """All use-after-donate findings for one module."""
+    if ctx.tree is None:
+        return []
+    out: List[Diagnostic] = []
+    # module-wide donating callables: self.X / X assigned from a donating
+    # jit or a registered factory anywhere in the module
+    donating: Dict[str, Tuple[int, ...]] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign):
+            spec = _donating_call_spec(node.value)
+            if spec is None and isinstance(node.value, ast.Call):
+                callee = dotted_name(node.value.func)
+                if callee:
+                    spec = factories.get(callee.rsplit(".", 1)[-1])
+            if spec:
+                for t in assign_targets(node):
+                    donating[t] = spec
+        if _untrackable_donation(node):
+            out.append(
+                Diagnostic(
+                    ctx.path,
+                    node.lineno,
+                    rule_id,
+                    "this jit donates but the positions are not "
+                    "statically trackable (donate_argnames, or a "
+                    "non-literal donate_argnums expression) — the pass "
+                    "would be blind to every use-after-donate through "
+                    "this callable; use a literal donate_argnums tuple, "
+                    "or waive this definition with a why",
+                )
+            )
+    if not donating:
+        return out
+    # every def is analyzed (closures reading a donated name must flag),
+    # and a nested def's findings also surface through its parent's walk —
+    # dedupe on (line, donated name) so each read reports once
+    seen: set = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in _FuncAnalysis(node, donating, ctx.path, rule_id).run():
+                key = (d.line, d.context)
+                if key not in seen:
+                    seen.add(key)
+                    out.append(d)
+    return out
+
+
+class UseAfterDonateRule(Rule):
+    id = "use-after-donate"
+    summary = "no reads of a variable after its buffers were donated to XLA"
+
+    def paths(self) -> Iterable[str]:
+        return package_py_files()
+
+    def check(self, files: Dict[str, FileCtx]) -> List[Diagnostic]:
+        factories = build_factory_registry(files)
+        out: List[Diagnostic] = []
+        for rel in sorted(files):
+            if not rel.startswith("dotaclient_tpu/"):
+                continue
+            out.extend(analyze_module(files[rel], factories, self.id))
+        return out

@@ -1,0 +1,284 @@
+"""host-sync pass: no per-step host↔device syncs in the hot-path modules.
+
+The learner's throughput story rests on a discipline, not a mechanism: the
+train loop is dispatch-only, and device values are fetched exactly once per
+``log_every`` boundary (docs/ARCHITECTURE.md "Observability", "Pipelined
+data path"). That discipline regresses silently — one stray
+``float(metrics["loss"])`` in the loop turns dispatch-rate training into
+sync-rate training, and nothing crashes.
+
+This pass is the static tripwire (grown from the PR 2 standalone
+``scripts/check_host_sync.py``, which remains as a thin CLI wrapper with
+byte-compatible exit codes). It AST-scans the hot-path modules for the
+call patterns that read device values onto the host:
+
+* ``np.asarray(...)`` / ``np.array(...)``
+* ``jax.device_get(...)``
+* ``<x>.item()``
+* ``<x>.block_until_ready()`` / ``jax.block_until_ready(...)``
+* ``float(...)``
+
+and flags each occurrence that is neither inside an ALLOWED function
+(construction/checkpoint/boundary code that runs off the hot path by
+design — ``ALLOWED_FUNCS``) nor annotated at the line. Two annotation
+spellings are honored: the historical ``# host-sync-ok: <why>`` (hundreds
+of sites predate the framework) and the framework-standard
+``# lint-ok: host-sync(<why>)``.
+
+Static analysis cannot prove a ``float()`` touches a device value — most
+annotated ones wrap host integers — but every NEW unannotated occurrence
+is exactly the kind of line a reviewer must look at. The point is
+friction: adding a sync to the hot path requires either an annotation
+(visible in review) or an allowlist edit (more visible).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from dotaclient_tpu.lint.core import Diagnostic, FileCtx, Rule
+
+# Functions that legitimately sync: construction, checkpoint/restore, and
+# log-boundary drains. Regressions INSIDE these functions are
+# boundary-cadence, not per-step — out of scope for this pass (the
+# telemetry tests count actual fetches per step). Note _publish_weights is
+# deliberately NOT here anymore (ISSUE 5): with the async snapshot engine
+# it must be dispatch-only on the train thread — any sync pattern added to
+# it now needs a visible annotation.
+ALLOWED_FUNCS: Dict[str, Set[str]] = {
+    "dotaclient_tpu/train/learner.py": {
+        "__init__",
+        "_pipeline_state",
+        "_restore_pipeline",
+        "_flush_league_reports",
+        "_publish_pipeline_gauges",
+        "_maybe_save_best",
+        "main",
+    },
+    "dotaclient_tpu/buffer/trajectory_buffer.py": {
+        "__init__",
+        "_matches_slot",
+        "_payload_finite",      # admission door: host arrays only (ISSUE 6)
+        "_payload_in_bounds",   # admission door: host arrays only (ISSUE 7)
+        "state_dict",
+        "load_state_dict",
+        "_publish_telemetry",
+        "metrics",
+    },
+    # Health monitor (ISSUE 6): submit/take_pending run on the train
+    # thread and must stay host-only; the fold side receives ALREADY
+    # fetched scalars (the engine's one batched transfer) — its float()
+    # casts are annotated at the line.
+    "dotaclient_tpu/train/health.py": set(),
+    # The snapshot engine IS the designated sync site (ISSUE 5): its one
+    # batched fetch is annotated at the line, everything else must stay
+    # host-only — no function-level pass.
+    "dotaclient_tpu/train/snapshot.py": set(),
+    # Checkpointing: restores are user-initiated and sync by design; the
+    # save path must do exactly ONE batched fetch (annotated) and the
+    # snapshot-thread entry point (save_host) none at all.
+    "dotaclient_tpu/utils/checkpoint.py": {
+        "shape_mismatches",
+        "restore",
+        "restore_weights",
+        "restore_config",
+        "restore_pipeline",
+    },
+}
+
+# Modules where only the PUBLISH path is in scope (ISSUE 5): the transports
+# are big and mostly reader-side, but publish_weights runs on the learner's
+# snapshot thread (async) or train thread (sync debug mode) — a host↔device
+# sync slipping in there silently re-serializes the fanout behind device
+# work. Only the named functions are scanned; the rest of each module is
+# out of this pass's scope.
+SCAN_ONLY_FUNCS: Dict[str, Set[str]] = {
+    # consume_decoded (ISSUE 7) feeds the buffer's consume-time upcast:
+    # it runs on the learner thread every ingest and its byte accounting
+    # must stay host-int arithmetic — a sync pattern there would serialize
+    # the whole ingest drain behind device work.
+    "dotaclient_tpu/transport/socket_transport.py": {
+        "publish_weights", "_writer_loop", "consume_decoded",
+    },
+    "dotaclient_tpu/transport/shm_transport.py": {
+        "publish_weights", "consume_decoded",
+    },
+    "dotaclient_tpu/transport/queues.py": {"publish_weights"},
+    # The shared byte-accounting body both consume_decoded paths call
+    # (ISSUE 7 review round 3): the accounting itself lives here now, so
+    # the tripwire must follow it.
+    "dotaclient_tpu/transport/serialize.py": {"decode_drained_payloads"},
+}
+
+ANNOTATION = "host-sync-ok"
+_FRAMEWORK_ANNOTATION = "lint-ok: host-sync("
+
+
+def _pattern_of(call: ast.Call) -> Optional[str]:
+    """Name of the sync pattern a Call node matches, or None."""
+    fn = call.func
+    if isinstance(fn, ast.Name) and fn.id == "float":
+        return "float()"
+    if isinstance(fn, ast.Attribute):
+        base = fn.value
+        base_name = base.id if isinstance(base, ast.Name) else None
+        if fn.attr in ("asarray", "array") and base_name == "np":
+            return f"np.{fn.attr}()"
+        if fn.attr == "device_get" and base_name == "jax":
+            return "jax.device_get()"
+        if fn.attr == "item" and not call.args:
+            return ".item()"
+        if fn.attr == "block_until_ready":
+            return ".block_until_ready()"
+    return None
+
+
+class _Scanner(ast.NodeVisitor):
+    def __init__(self) -> None:
+        self.func_stack: List[str] = []
+        self.hits: List[Tuple[int, str, Optional[str]]] = []
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.func_stack.append(node.name)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        pat = _pattern_of(node)
+        if pat is not None:
+            # innermost NAMED def wins: closures like after_step() get
+            # their own identity instead of hiding under train()
+            fn = self.func_stack[-1] if self.func_stack else None
+            self.hits.append((node.lineno, pat, fn))
+        self.generic_visit(node)
+
+
+def scan_source(
+    source: str,
+    allowed_funcs: Set[str],
+    filename: str = "<string>",
+    scan_only: Optional[Set[str]] = None,
+) -> List[Tuple[int, str, Optional[str]]]:
+    """Structured findings for one module: (line, pattern, func) triples
+    that are neither allowed nor annotated (either spelling).
+
+    ``scan_only`` restricts the scan to the named functions (the publish-
+    path modules); ``None`` scans the whole module."""
+    tree = ast.parse(source, filename)
+    scanner = _Scanner()
+    scanner.visit(tree)
+    lines = source.splitlines()
+    out: List[Tuple[int, str, Optional[str]]] = []
+    for lineno, pat, func in scanner.hits:
+        if scan_only is not None and func not in scan_only:
+            continue
+        if func in allowed_funcs:
+            continue
+        here = lines[lineno - 1] if lineno - 1 < len(lines) else ""
+        above = lines[lineno - 2] if lineno >= 2 else ""
+        if any(
+            mark in text
+            for mark in (ANNOTATION, _FRAMEWORK_ANNOTATION)
+            for text in (here, above)
+        ):
+            continue
+        out.append((lineno, pat, func))
+    return out
+
+
+def _message(pat: str, func: Optional[str]) -> str:
+    where = f"in {func}()" if func else "at module level"
+    return (
+        f"{pat} {where} — a host↔device sync pattern on the hot path; "
+        f"move it behind a log_every boundary, or annotate "
+        f"'# {ANNOTATION}: <why>' if it only touches host values"
+    )
+
+
+def check_source(
+    source: str,
+    allowed_funcs: Set[str],
+    filename: str = "<string>",
+    scan_only: Optional[Set[str]] = None,
+) -> List[str]:
+    """Violation strings for one module's source (empty = clean) — the
+    historical ``scripts/check_host_sync.py`` surface, byte-compatible
+    with its pre-framework output (tests/test_telemetry.py pins it)."""
+    return [
+        f"{filename}:{lineno}: {_message(pat, func)}"
+        for lineno, pat, func in scan_source(
+            source, allowed_funcs, filename, scan_only=scan_only
+        )
+    ]
+
+
+class HostSyncRule(Rule):
+    id = "host-sync"
+    summary = (
+        "hot-path modules carry no unannotated host<->device sync patterns"
+    )
+
+    def paths(self) -> Iterable[str]:
+        return sorted(ALLOWED_FUNCS) + sorted(SCAN_ONLY_FUNCS)
+
+    def check(self, files: Dict[str, FileCtx]) -> List[Diagnostic]:
+        out: List[Diagnostic] = []
+        for rel, allowed in sorted(ALLOWED_FUNCS.items()):
+            ctx = files.get(rel)
+            if ctx is None:
+                continue
+            for lineno, pat, func in scan_source(ctx.source, allowed, rel):
+                out.append(
+                    Diagnostic(
+                        rel, lineno, self.id, _message(pat, func),
+                        context=func or "",
+                    )
+                )
+        for rel, only in sorted(SCAN_ONLY_FUNCS.items()):
+            ctx = files.get(rel)
+            if ctx is None:
+                continue
+            for lineno, pat, func in scan_source(
+                ctx.source, set(), rel, scan_only=only
+            ):
+                out.append(
+                    Diagnostic(
+                        rel, lineno, self.id, _message(pat, func),
+                        context=func or "",
+                    )
+                )
+        return out
+
+
+def run_standalone(argv: Optional[List[str]] = None) -> int:
+    """The ``scripts/check_host_sync.py`` entry point: exit 0 when clean,
+    1 with per-line diagnostics on stderr — byte-compatible with the
+    pre-framework script so existing CI wiring keeps working."""
+    import argparse
+    import os
+    import sys
+
+    from dotaclient_tpu.lint.core import REPO_ROOT
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.parse_args(argv)
+    all_violations: List[str] = []
+    for rel, allowed in sorted(ALLOWED_FUNCS.items()):
+        with open(os.path.join(REPO_ROOT, rel)) as f:
+            all_violations.extend(check_source(f.read(), allowed, rel))
+    for rel, only in sorted(SCAN_ONLY_FUNCS.items()):
+        with open(os.path.join(REPO_ROOT, rel)) as f:
+            all_violations.extend(
+                check_source(f.read(), set(), rel, scan_only=only)
+            )
+    if all_violations:
+        print("host-sync discipline check FAILED:", file=sys.stderr)
+        for v in all_violations:
+            print(f"  - {v}", file=sys.stderr)
+        return 1
+    scanned = sorted(ALLOWED_FUNCS) + sorted(SCAN_ONLY_FUNCS)
+    print(f"host-sync discipline OK: {', '.join(scanned)}")
+    return 0

@@ -1,0 +1,336 @@
+"""thread-ownership pass: declarative per-thread state ownership, checked.
+
+The learner stack is a small fleet of threads — the train thread, the
+snapshot engine thread, the transport's accept/reader/writer threads, an
+optional in-process actor thread — and the last three PRs each needed a
+post-review fix for a shared-state race between them (``_pending_best``
+swap, ``_last_verdict_m`` re-fold after rollback, the sync-gate fold
+ordering). Locks were the fix each time; what was missing was a *declared*
+ownership model a machine can re-check on every commit.
+
+``OWNERSHIP`` below is that declaration. For each mapped class:
+
+* ``default_thread`` + ``methods`` assign every method (and named nested
+  def — closures resolve to the innermost declared name) to the thread it
+  runs on;
+* ``attrs`` maps each guarded attribute to its discipline:
+
+  - ``"<thread>"`` — only methods on that thread may touch it;
+  - ``"lock:<attr>"`` — any thread, but the access must be lexically
+    inside ``with self.<attr>:`` (or in a method listed in ``holds`` as
+    called-with-the-lock-held, the ``*_locked`` helper convention);
+  - ``"any"`` — explicitly unguarded (documented free-for-all, e.g. a
+    latched bool the readers tolerate stale).
+
+Unmapped attributes are unchecked: the map is a statement of the
+disciplines that matter, not an inventory. ``__init__`` is exempt — the
+object is not shared until construction returns. Deliberate exceptions
+(handoff-after-barrier reads, monotonic-value races) are waived at the
+line with ``# lint-ok: thread-ownership(<why>)`` so the reasoning is in
+the diff, not the reviewer's head.
+
+The three PR 5–6 race shapes are pinned as fixtures in
+``tests/test_lint.py`` — this pass flags each of them.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Mapping, Tuple
+
+from dotaclient_tpu.lint.core import Diagnostic, FileCtx, Rule, dotted_name
+
+
+@dataclasses.dataclass(frozen=True)
+class ClassMap:
+    default_thread: str
+    methods: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    attrs: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    # method name → lock attrs the CALLER is contractually holding (the
+    # `_locked`-suffix helper convention: the lock is acquired upstream)
+    holds: Mapping[str, Tuple[str, ...]] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+# The shipped ownership model. Thread names are labels, not OS identities:
+# "train" = the thread running Learner.train(), "engine" = the snapshot
+# thread, "reader"/"writer"/"accept" = the transport's per-connection and
+# accept threads, "learner" = the single consuming/publishing side of a
+# transport object.
+OWNERSHIP: Dict[str, Dict[str, ClassMap]] = {
+    "dotaclient_tpu/train/learner.py": {
+        "Learner": ClassMap(
+            default_thread="train",
+            methods={
+                # the async log-boundary continuation runs ON the snapshot
+                # thread (submitted via submit_metrics)
+                "_finish_metrics": "engine",
+                # overlap mode's in-process actor pool thread
+                "actor_loop": "actor",
+                # signal-handler entry: one latched flag write
+                "request_stop": "any",
+            },
+            attrs={
+                # THE donation hazard: in-flight dispatches donate the
+                # TrainState's buffers, so only the train thread — which
+                # ordered those dispatches — may ever touch it.
+                "state": "train",
+                # deferred best-model candidate: written by the snapshot
+                # thread's metrics continuation, consumed on the train
+                # thread — the PR 5 race fix made the swap lock-protected.
+                "_pending_best": "lock:_pending_best_lock",
+                # sync-gate fold state (PR 6 race fix): cleared by rollback
+                # and folded by sync boundaries, all on the train thread.
+                "_last_verdict_m": "train",
+                "_prefetched": "train",
+                "_prefetch_ticket": "train",
+                "_mb_rng": "train",
+                "_mb_draws": "train",
+                "_host_step": "train",
+                "_host_version": "train",
+                "_dispatch_inflight": "train",
+                "_stall_s": "train",
+                "_published_version": "train",
+                "_rollback_count": "train",
+                "_best_win": "train",
+                "_last_metrics": "train",
+                # latched stop flag: written by the signal handler, read by
+                # every loop — single bool write, stale reads are the design
+                "_stop_requested": "any",
+            },
+        ),
+    },
+    "dotaclient_tpu/train/snapshot.py": {
+        "SnapshotEngine": ClassMap(
+            default_thread="train",   # submit/drain/stop: caller side
+            methods={
+                "_run": "engine",
+                "_fetch": "engine",
+                "_do_publish": "engine",
+                "_do_checkpoint": "engine",
+                "_do_metrics": "engine",
+            },
+            attrs={
+                "_jobs": "lock:_cond",
+                "_stats_jobs": "lock:_cond",
+                "_busy": "lock:_cond",
+                "_stopped": "lock:_cond",
+                # engine-private monotonic floor; the train thread reads it
+                # only after drain() (waived at the property)
+                "_last_published": "engine",
+            },
+            holds={"_pending_locked": ("_cond",)},
+        ),
+    },
+    "dotaclient_tpu/train/health.py": {
+        "HealthMonitor": ClassMap(
+            default_thread="any",   # called from train AND engine threads
+            attrs={
+                "_pending": "lock:_lock",
+                "_gen": "lock:_lock",
+                "_ema_grad": "lock:_lock",
+                "_healthy_folds": "lock:_lock",
+                "_unhealthy": "lock:_lock",
+            },
+        ),
+    },
+    "dotaclient_tpu/transport/socket_transport.py": {
+        "TransportServer": ClassMap(
+            default_thread="learner",
+            methods={
+                "_accept_loop": "accept",
+                "_reader_loop": "reader",
+                "_poison": "reader",
+                "_enqueue_rollouts": "reader",
+                "_writer_loop": "writer",
+                # torn down from readers, writers, publish, and close alike;
+                # it touches only lock-guarded state and the conn's own cond
+                "_drop": "any",
+                "close": "any",
+            },
+            attrs={
+                "_rollouts": "lock:_roll_cond",
+                "_conns": "lock:_conns_lock",
+                "_latest_weights": "lock:_weights_lock",
+                "_latest_payload": "lock:_weights_lock",
+                "_latest_crc": "lock:_weights_lock",
+                "_publish_seq": "lock:_weights_lock",
+                "dropped": "lock:_roll_cond",
+                "bad_payloads": "learner",
+                "_rollout_totals": "learner",
+            },
+        ),
+    },
+    "dotaclient_tpu/transport/shm_transport.py": {
+        # Single-consumer by design: every method runs on the learner
+        # thread (no background threads in the shm server — liveness is
+        # the pid beacon, not a thread). The map pins that: the first
+        # future thread added here trips the pass instead of a review.
+        "ShmTransportServer": ClassMap(
+            default_thread="learner",
+            attrs={
+                "_consumed": "learner",
+                "_next_ring": "learner",
+                "_last_telemetry": "learner",
+                "_bad_streak": "learner",
+                "_quarantined": "learner",
+                "_rollout_totals": "learner",
+                "_closed": "learner",
+            },
+        ),
+    },
+}
+
+
+def _with_lock_stack(
+    node: ast.With, lock_prefix: str = "self."
+) -> List[str]:
+    """Lock attr names a With statement acquires (``with self._lock:`` →
+    ["_lock"])."""
+    out = []
+    for item in node.items:
+        name = dotted_name(item.context_expr)
+        if name and name.startswith(lock_prefix):
+            out.append(name[len(lock_prefix):])
+    return out
+
+
+class _ClassScanner:
+    def __init__(
+        self, rel: str, cls: ast.ClassDef, cmap: ClassMap, rule_id: str
+    ) -> None:
+        self.rel = rel
+        self.cls = cls
+        self.cmap = cmap
+        self.rule_id = rule_id
+        self.out: List[Diagnostic] = []
+
+    def scan(self) -> List[Diagnostic]:
+        for stmt in self.cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if stmt.name == "__init__":
+                    continue  # construction: the object is not shared yet
+                self._scan_def(stmt, def_stack=[stmt.name], locks=[])
+        return self.out
+
+    def _thread_of(self, def_stack: List[str]) -> str:
+        for name in reversed(def_stack):
+            if name in self.cmap.methods:
+                return self.cmap.methods[name]
+        return self.cmap.default_thread
+
+    def _scan_def(
+        self,
+        node: ast.AST,
+        def_stack: List[str],
+        locks: List[str],
+    ) -> None:
+        held = list(locks)
+        for outer_name in def_stack:
+            held.extend(self.cmap.holds.get(outer_name, ()))
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, def_stack, held)
+
+    def _visit(
+        self, node: ast.AST, def_stack: List[str], locks: List[str]
+    ) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._scan_def(node, def_stack + [node.name], locks)
+            return
+        if isinstance(node, ast.With):
+            inner = locks + _with_lock_stack(node)
+            for child in ast.iter_child_nodes(node):
+                self._visit(child, def_stack, inner)
+            return
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            self._check_access(node, def_stack, locks)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, def_stack, locks)
+
+    def _check_access(
+        self, node: ast.Attribute, def_stack: List[str], locks: List[str]
+    ) -> None:
+        spec = self.cmap.attrs.get(node.attr)
+        if spec is None or spec == "any":
+            return
+        method = def_stack[-1]
+        if spec.startswith("lock:"):
+            lock = spec[5:]
+            if lock in locks:
+                return
+            self.out.append(
+                Diagnostic(
+                    self.rel,
+                    node.lineno,
+                    self.rule_id,
+                    f"'{self.cls.name}.{node.attr}' accessed in "
+                    f"{method}() outside 'with self.{lock}:' — the "
+                    f"ownership map (lint/ownership.py) declares it "
+                    f"lock-guarded; acquire the lock, list the method "
+                    f"under holds=, or waive with a why",
+                    context=f"{self.cls.name}.{method}.{node.attr}",
+                )
+            )
+            return
+        thread = self._thread_of(def_stack)
+        if thread == spec:
+            return
+        self.out.append(
+            Diagnostic(
+                self.rel,
+                node.lineno,
+                self.rule_id,
+                f"'{self.cls.name}.{node.attr}' is owned by the "
+                f"{spec} thread but {method}() runs on the "
+                f"{thread} thread (ownership map, lint/ownership.py) — "
+                f"marshal through the owner, add a lock, or waive with "
+                f"a why",
+                context=f"{self.cls.name}.{method}.{node.attr}",
+            )
+        )
+
+
+def scan_source_with_map(
+    rel: str, source: str, class_maps: Dict[str, ClassMap],
+    rule_id: str = "thread-ownership",
+) -> List[Diagnostic]:
+    """Scan one module against an explicit map (the unit-test surface —
+    fixtures inject race-shaped snippets with a matching map)."""
+    tree = ast.parse(source, rel)
+    out: List[Diagnostic] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name in class_maps:
+            out.extend(
+                _ClassScanner(
+                    rel, node, class_maps[node.name], rule_id
+                ).scan()
+            )
+    return out
+
+
+class ThreadOwnershipRule(Rule):
+    id = "thread-ownership"
+    summary = (
+        "shared attributes are touched only by their owning thread or "
+        "under their declared lock"
+    )
+
+    def paths(self) -> Iterable[str]:
+        return sorted(OWNERSHIP)
+
+    def check(self, files: Dict[str, FileCtx]) -> List[Diagnostic]:
+        out: List[Diagnostic] = []
+        for rel in sorted(OWNERSHIP):
+            ctx = files.get(rel)
+            if ctx is None:
+                continue
+            out.extend(
+                scan_source_with_map(rel, ctx.source, OWNERSHIP[rel], self.id)
+            )
+        return out

@@ -128,6 +128,9 @@ class HealthMonitor:
 
     @property
     def unhealthy(self) -> Optional[HealthEvent]:
+        # lint-ok: thread-ownership(lock-free latch read: the event only
+        # transitions None->set under _lock and is immutable until clear();
+        # a stale None merely delays gate closure to the next fold)
         return self._unhealthy
 
     def note_unrecoverable(self) -> bool:
@@ -164,13 +167,23 @@ class HealthMonitor:
     def fold_host(self, step: int, version: int, scalars: Dict[str, Any]) -> None:
         """Fold already-fetched host scalars (the --sync-snapshots path:
         the boundary metrics fetch carries the verdict keys — no second
-        transfer)."""
+        transfer). Always folds with the CURRENT generation (``gen=None``
+        below) — reading ``self._gen`` here would race ``clear()``, and
+        sync-mode callers are by definition post-rollback callers of the
+        live timeline (train/learner.py clears ``_last_verdict_m`` at
+        rollback so no stale verdict can reach this path)."""
         if all(k in scalars for k in ("loss", "grad_norm")):
-            self._fold_one(self._gen, step, version, scalars)
+            self._fold_one(None, step, version, scalars)
 
-    def _fold_one(self, gen: int, step: int, version: int, tree: Any) -> None:
+    def _fold_one(
+        self, gen: Optional[int], step: int, version: int, tree: Any
+    ) -> None:
+        """``gen=None`` means "the current generation" (the fold_host
+        path); a concrete gen is compared against the latest clear()."""
         with self._lock:
-            if gen != self._gen or self._unhealthy is not None:
+            if (
+                gen is not None and gen != self._gen
+            ) or self._unhealthy is not None:
                 return  # abandoned timeline, or already latched
             loss = float(tree["loss"])   # host-sync-ok: fetched host scalars
             gn = float(tree["grad_norm"])   # host-sync-ok: fetched host scalars
@@ -200,5 +213,7 @@ class HealthMonitor:
             "health: divergence latched at step %d (version %d): %s "
             "(value %r) — weight publishes and periodic checkpoints are "
             "blocked until rollback",
+            # lint-ok: thread-ownership(only reached by the thread that just
+            # latched the event; latched values are immutable until clear)
             step, version, self._unhealthy.reason, self._unhealthy.value,
         )

@@ -1164,6 +1164,12 @@ class Learner:
         — the actual device stat drain rides the engine's never-coalesced
         ``submit_stats`` backlog, so a coalesced log line can never lose an
         episode window."""
+        # captured HERE, on the train thread: _best_win is train-owned
+        # (lint/ownership.py) and reading it from the snapshot thread was
+        # an unsynchronized race — the submit-time value is also the more
+        # honest log field (the save that could move it is itself deferred
+        # back to the train thread and lands after this boundary)
+        best_win = self._best_win
 
         def _finish_metrics(host) -> None:
             scalars = {k: float(v) for k, v in host["m"].items()}   # host-sync-ok: snapshot thread, fetched host arrays
@@ -1178,7 +1184,10 @@ class Learner:
                 # boundary (or the end-of-run drain) — see _drain_snapshots
                 with self._pending_best_lock:
                     self._pending_best = dict(scalars)
-                scalars["best_win_rate"] = self._best_win
+                scalars["best_win_rate"] = best_win
+            # lint-ok: thread-ownership(handoff, not shared state: train()
+            # reads _last_metrics only after the _drain_snapshots barrier
+            # has joined every pending engine job)
             self._last_metrics = self.metrics.log(step, scalars)
 
         return _finish_metrics
@@ -1659,6 +1668,12 @@ def main(argv=None) -> Dict[str, float]:
         "'enabled=false'",
     )
     p.add_argument(
+        "--learner", type=str, default=None, metavar="K=V,...",
+        help="comma-separated LearnerConfig overrides (snapshot-engine "
+        "knobs, ISSUE 5), e.g. 'snapshot_drain_timeout_s=120' or "
+        "'async_snapshots=false' (the long form of --sync-snapshots)",
+    )
+    p.add_argument(
         "--sync-snapshots", action="store_true",
         help="debug opt-out of the async snapshot engine (ISSUE 5): run "
         "the weights publish, periodic checkpoints, and log-boundary "
@@ -1818,6 +1833,7 @@ def main(argv=None) -> Dict[str, float]:
         BufferConfig,
         HealthConfig,
         LeagueConfig,
+        LearnerConfig,
         PPOConfig,
         RewardConfig,
     )
@@ -1832,6 +1848,7 @@ def main(argv=None) -> Dict[str, float]:
         ("--league", args.league, "league", LeagueConfig),
         ("--buffer", args.buffer, "buffer", BufferConfig),
         ("--health", args.health, "health", HealthConfig),
+        ("--learner", args.learner, "learner", LearnerConfig),
     ):
         if not text:
             continue

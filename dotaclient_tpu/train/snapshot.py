@@ -278,6 +278,8 @@ class SnapshotEngine:
         audit's published-floor evidence — train/learner.py; rollback
         keeps the version counter monotone, so the floor never needs a
         rewind)."""
+        # lint-ok: thread-ownership(rollback reads this only after drain()
+        # returned — the engine thread is provably idle at that point)
         return self._last_published
 
     def _do_publish(self, params: Any, version: int) -> None:

@@ -1,225 +1,58 @@
-"""CI guard: no per-step host↔device syncs sneak into the hot-path modules.
+"""CI guard: no per-step host↔device syncs in the hot-path modules.
 
-The learner's throughput story rests on a discipline, not a mechanism: the
-train loop is dispatch-only, and device values are fetched exactly once per
-``log_every`` boundary (docs/ARCHITECTURE.md "Observability",
-"Pipelined data path"). That discipline regresses silently — one stray
-``float(metrics["loss"])`` in the loop turns dispatch-rate training into
-sync-rate training, and nothing crashes.
+THIN WRAPPER (ISSUE 9). The actual analysis — pattern matching, the
+``ALLOWED_FUNCS``/``SCAN_ONLY_FUNCS`` module lists, and the annotation
+escape hatch — lives in :mod:`dotaclient_tpu.lint.host_sync`, where it
+runs as the ``host-sync`` pass of the multi-pass static-analysis
+framework (``python -m dotaclient_tpu.lint``; docs/ARCHITECTURE.md
+"Static analysis"). This script remains for the existing CI wiring and
+keeps the historical contract byte-compatible:
 
-This script is the static tripwire. It AST-scans the hot-path modules
-(``train/learner.py``, ``buffer/trajectory_buffer.py``) for the call
-patterns that read device values onto the host:
+* exit 0 when clean, printing ``host-sync discipline OK: <modules>``;
+* exit 1 with per-line ``file:line: <pattern> in <func>() — ...``
+  diagnostics on stderr under a ``host-sync discipline check FAILED:``
+  header;
+* ``check_source``, ``ALLOWED_FUNCS``, ``SCAN_ONLY_FUNCS``, and
+  ``ANNOTATION`` re-exported unchanged for the tests that drive them
+  (tests/test_telemetry.py).
 
-* ``np.asarray(...)`` / ``np.array(...)``
-* ``jax.device_get(...)``
-* ``<x>.item()``
-* ``<x>.block_until_ready()`` / ``jax.block_until_ready(...)``
-* ``float(...)``
-
-and fails unless each occurrence is either
-
-* inside an ALLOWED function — construction/checkpoint/boundary code that
-  runs off the hot path by design (see ``ALLOWED_FUNCS``), or
-* explicitly annotated with a ``# host-sync-ok: <why>`` comment on the
-  same line (or the line above) — the conscious-override escape hatch.
-
-The point is friction: adding a sync to the hot path now requires either
-an annotation (visible in review) or an allowlist edit (more visible).
-Static analysis cannot prove a ``float()`` touches a device value — most
-annotated ones wrap host integers — but every NEW unannotated occurrence
-is exactly the kind of line a reviewer must look at.
-
-Exit 0 when clean; 1 with per-line diagnostics. Run by tier-1 via
-tests/test_telemetry.py.
+Annotate a deliberate host-value sync with ``# host-sync-ok: <why>`` on
+the line (or the line above); the framework-standard
+``# lint-ok: host-sync(<why>)`` spelling works too. Allowlist edits go in
+``dotaclient_tpu/lint/host_sync.py`` now — the per-module function lists
+(and the reasoning for each) moved there with the analysis.
 
 Usage:
     python scripts/check_host_sync.py
+    python -m dotaclient_tpu.lint --rule host-sync   # framework form
 """
 
 from __future__ import annotations
 
-import argparse
-import ast
 import os
 import sys
-from typing import Dict, List, Optional, Set, Tuple
 
-REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# direct `python scripts/check_host_sync.py` invocation: the package root
+# must be importable before the framework import below
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
 
-# Functions that legitimately sync: construction, checkpoint/restore,
-# and log-boundary drains. Regressions INSIDE these functions are
-# boundary-cadence, not per-step — out of scope for this guard (the
-# telemetry tests count actual fetches per step). Note _publish_weights is
-# deliberately NOT here anymore (ISSUE 5): with the async snapshot engine
-# it must be dispatch-only on the train thread — any sync pattern added to
-# it now needs a visible annotation.
-ALLOWED_FUNCS: Dict[str, Set[str]] = {
-    "dotaclient_tpu/train/learner.py": {
-        "__init__",
-        "_pipeline_state",
-        "_restore_pipeline",
-        "_flush_league_reports",
-        "_publish_pipeline_gauges",
-        "_maybe_save_best",
-        "main",
-    },
-    "dotaclient_tpu/buffer/trajectory_buffer.py": {
-        "__init__",
-        "_matches_slot",
-        "_payload_finite",      # admission door: host arrays only (ISSUE 6)
-        "_payload_in_bounds",   # admission door: host arrays only (ISSUE 7)
-        "state_dict",
-        "load_state_dict",
-        "_publish_telemetry",
-        "metrics",
-    },
-    # Health monitor (ISSUE 6): submit/take_pending run on the train
-    # thread and must stay host-only; the fold side receives ALREADY
-    # fetched scalars (the engine's one batched transfer) — its float()
-    # casts are annotated at the line.
-    "dotaclient_tpu/train/health.py": set(),
-    # The snapshot engine IS the designated sync site (ISSUE 5): its one
-    # batched fetch is annotated at the line, everything else must stay
-    # host-only — no function-level pass.
-    "dotaclient_tpu/train/snapshot.py": set(),
-    # Checkpointing: restores are user-initiated and sync by design; the
-    # save path must do exactly ONE batched fetch (annotated) and the
-    # snapshot-thread entry point (save_host) none at all.
-    "dotaclient_tpu/utils/checkpoint.py": {
-        "shape_mismatches",
-        "restore",
-        "restore_weights",
-        "restore_config",
-        "restore_pipeline",
-    },
-}
+from dotaclient_tpu.lint.host_sync import (  # noqa: E402  (path setup above)
+    ALLOWED_FUNCS,
+    ANNOTATION,
+    SCAN_ONLY_FUNCS,
+    check_source,
+    run_standalone as main,
+)
 
-# Modules where only the PUBLISH path is in scope (ISSUE 5): the transports
-# are big and mostly reader-side, but publish_weights runs on the learner's
-# snapshot thread (async) or train thread (sync debug mode) — a host↔device
-# sync slipping in there silently re-serializes the fanout behind device
-# work. Only the named functions are scanned; the rest of each module is
-# out of this guard's scope.
-SCAN_ONLY_FUNCS: Dict[str, Set[str]] = {
-    # consume_decoded (ISSUE 7) feeds the buffer's consume-time upcast:
-    # it runs on the learner thread every ingest and its byte accounting
-    # must stay host-int arithmetic — a sync pattern there would serialize
-    # the whole ingest drain behind device work.
-    "dotaclient_tpu/transport/socket_transport.py": {
-        "publish_weights", "_writer_loop", "consume_decoded",
-    },
-    "dotaclient_tpu/transport/shm_transport.py": {
-        "publish_weights", "consume_decoded",
-    },
-    "dotaclient_tpu/transport/queues.py": {"publish_weights"},
-    # The shared byte-accounting body both consume_decoded paths call
-    # (review round 3): the accounting itself lives here now, so the
-    # tripwire must follow it.
-    "dotaclient_tpu/transport/serialize.py": {"decode_drained_payloads"},
-}
-
-ANNOTATION = "host-sync-ok"
-
-
-def _pattern_of(call: ast.Call) -> Optional[str]:
-    """Name of the sync pattern a Call node matches, or None."""
-    fn = call.func
-    if isinstance(fn, ast.Name) and fn.id == "float":
-        return "float()"
-    if isinstance(fn, ast.Attribute):
-        base = fn.value
-        base_name = base.id if isinstance(base, ast.Name) else None
-        if fn.attr in ("asarray", "array") and base_name == "np":
-            return f"np.{fn.attr}()"
-        if fn.attr == "device_get" and base_name == "jax":
-            return "jax.device_get()"
-        if fn.attr == "item" and not call.args:
-            return ".item()"
-        if fn.attr == "block_until_ready":
-            return ".block_until_ready()"
-    return None
-
-
-class _Scanner(ast.NodeVisitor):
-    def __init__(self) -> None:
-        self.func_stack: List[str] = []
-        self.hits: List[Tuple[int, str, Optional[str]]] = []
-
-    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
-        self.func_stack.append(node.name)
-        self.generic_visit(node)
-        self.func_stack.pop()
-
-    visit_AsyncFunctionDef = visit_FunctionDef
-
-    def visit_Call(self, node: ast.Call) -> None:
-        pat = _pattern_of(node)
-        if pat is not None:
-            # innermost NAMED def wins: closures like after_step() get
-            # their own identity instead of hiding under train()
-            fn = self.func_stack[-1] if self.func_stack else None
-            self.hits.append((node.lineno, pat, fn))
-        self.generic_visit(node)
-
-
-def check_source(
-    source: str,
-    allowed_funcs: Set[str],
-    filename: str = "<string>",
-    scan_only: Optional[Set[str]] = None,
-) -> List[str]:
-    """Return violation strings for one module's source (empty = clean).
-
-    ``scan_only`` restricts the scan to the named functions (the publish-
-    path modules); ``None`` scans the whole module."""
-    tree = ast.parse(source, filename)
-    scanner = _Scanner()
-    scanner.visit(tree)
-    lines = source.splitlines()
-    violations = []
-    for lineno, pat, func in scanner.hits:
-        if scan_only is not None and func not in scan_only:
-            continue
-        if func in allowed_funcs:
-            continue
-        here = lines[lineno - 1] if lineno - 1 < len(lines) else ""
-        above = lines[lineno - 2] if lineno >= 2 else ""
-        if ANNOTATION in here or ANNOTATION in above:
-            continue
-        where = f"in {func}()" if func else "at module level"
-        violations.append(
-            f"{filename}:{lineno}: {pat} {where} — a host↔device sync "
-            f"pattern on the hot path; move it behind a log_every boundary, "
-            f"or annotate '# {ANNOTATION}: <why>' if it only touches host "
-            f"values"
-        )
-    return violations
-
-
-def main(argv: Optional[List[str]] = None) -> int:
-    p = argparse.ArgumentParser(description=__doc__)
-    p.parse_args(argv)
-    all_violations: List[str] = []
-    for rel, allowed in sorted(ALLOWED_FUNCS.items()):
-        path = os.path.join(REPO_ROOT, rel)
-        with open(path) as f:
-            all_violations.extend(check_source(f.read(), allowed, rel))
-    for rel, only in sorted(SCAN_ONLY_FUNCS.items()):
-        path = os.path.join(REPO_ROOT, rel)
-        with open(path) as f:
-            all_violations.extend(
-                check_source(f.read(), set(), rel, scan_only=only)
-            )
-    if all_violations:
-        print("host-sync discipline check FAILED:", file=sys.stderr)
-        for v in all_violations:
-            print(f"  - {v}", file=sys.stderr)
-        return 1
-    scanned = sorted(ALLOWED_FUNCS) + sorted(SCAN_ONLY_FUNCS)
-    print(f"host-sync discipline OK: {', '.join(scanned)}")
-    return 0
-
+__all__ = [
+    "ALLOWED_FUNCS",
+    "ANNOTATION",
+    "SCAN_ONLY_FUNCS",
+    "check_source",
+    "main",
+]
 
 if __name__ == "__main__":
     sys.exit(main())

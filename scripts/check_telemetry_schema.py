@@ -14,6 +14,13 @@ existing file via ``--path``) and checks:
 Exit status 0 on success; 1 with a diagnostic on any violation. Invoked
 from the test suite (tests/test_telemetry.py), so tier-1 covers the schema.
 
+The hand-maintained tier lists below are themselves machine-checked: the
+``telemetry-drift`` pass of ``python -m dotaclient_tpu.lint`` statically
+extracts every key the package emits and fails CI when a tier list
+requires a key no code emits (and, symmetrically, when an emitted key is
+missing from the docs/ARCHITECTURE.md "Observability" tables). Renaming a
+counter without updating these tuples is caught before any smoke run.
+
 Usage:
     python scripts/check_telemetry_schema.py            # run smoke + validate
     python scripts/check_telemetry_schema.py --path x.jsonl   # validate only

@@ -1,0 +1,743 @@
+"""graftlint: the multi-pass static-analysis framework (ISSUE 9).
+
+Covers, per pass: a positive fixture (the pass flags its target pattern),
+an annotated-ok fixture (`# lint-ok: <rule>(<why>)` waives it), and a
+baseline-suppressed fixture (the fingerprint mechanism). The
+thread-ownership fixtures encode the three PR 5–6 race shapes
+(`_pending_best` swap, `_last_verdict_m` cross-thread fold state, the
+lock-guarded sync-gate fold) that motivated the pass; the use-after-donate
+fixtures encode the TPU-silent-corruption repro. The tier-1 wrapper test
+runs the real lint on HEAD (non-strict; LINT_STRICT=1 escalates to
+--strict, the TIER1_DURATION_STRICT pattern), which is the acceptance
+criterion: `python -m dotaclient_tpu.lint` exits 0 with >= 4 passes.
+
+Everything here is pure AST analysis — no jax, no devices — so the whole
+module runs in well under a second.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from dotaclient_tpu.lint import ALL_RULES
+from dotaclient_tpu.lint.core import (
+    REPO_ROOT,
+    Diagnostic,
+    FileCtx,
+    Rule,
+    fingerprint,
+    load_baseline,
+    run_rules,
+)
+from dotaclient_tpu.lint import (
+    config_drift,
+    donation,
+    host_sync,
+    ownership,
+    telemetry_drift,
+)
+
+
+def run_in_memory(rule, files_dict, baseline=(), strict=False):
+    """Mirror of core.run_rules over in-memory sources: returns (new,
+    suppressed) lists of (Diagnostic, fingerprint)."""
+    files = {p: FileCtx(p, src) for p, src in files_dict.items()}
+    new, suppressed = [], []
+    for d in rule.check(files):
+        ctx = files.get(d.path)
+        if ctx is not None and d.line and ctx.waived(d.line, rule.id):
+            continue
+        fp = fingerprint(d, ctx)
+        if not strict and fp in baseline:
+            suppressed.append((d, fp))
+        else:
+            new.append((d, fp))
+    return new, suppressed
+
+
+# ---------------------------------------------------------------------------
+# framework core
+
+
+class TestFrameworkCore:
+    def _fake_rule(self):
+        class FakeRule(Rule):
+            id = "fake"
+            summary = "test"
+
+            def paths(self):
+                return ["mod.py"]
+
+            def check(self, files):
+                out = []
+                for i, line in enumerate(files["mod.py"].lines, 1):
+                    if "BAD" in line:
+                        out.append(Diagnostic("mod.py", i, "fake", "boom"))
+                return out
+
+        return FakeRule()
+
+    def test_positive_waiver_and_baseline(self, tmp_path):
+        rule = self._fake_rule()
+        src = "x = BAD\n"
+        new, supp = run_in_memory(rule, {"mod.py": src})
+        assert len(new) == 1 and new[0][0].rule == "fake"
+        # annotated-ok: same line and line-above spellings
+        assert run_in_memory(
+            rule, {"mod.py": "x = BAD  # lint-ok: fake(known)\n"}
+        ) == ([], [])
+        assert run_in_memory(
+            rule, {"mod.py": "# lint-ok: fake(known)\nx = BAD\n"}
+        ) == ([], [])
+        # baseline-suppressed; --strict un-suppresses
+        fp = new[0][1]
+        new2, supp2 = run_in_memory(rule, {"mod.py": src}, baseline=(fp,))
+        assert new2 == [] and len(supp2) == 1
+        new3, _ = run_in_memory(
+            rule, {"mod.py": src}, baseline=(fp,), strict=True
+        )
+        assert len(new3) == 1
+
+    def test_waiver_is_rule_scoped(self):
+        rule = self._fake_rule()
+        new, _ = run_in_memory(
+            rule, {"mod.py": "x = BAD  # lint-ok: other-rule(nope)\n"}
+        )
+        assert len(new) == 1, "a waiver for another rule must not suppress"
+
+    def test_waiver_comment_block_walkup(self):
+        """A multi-line why in a contiguous comment block above the
+        finding still waives — the why is encouraged to be thorough."""
+        rule = self._fake_rule()
+        src = (
+            "# lint-ok: fake(a long explanation that\n"
+            "# continues over several comment lines\n"
+            "# before the flagged statement)\n"
+            "x = BAD\n"
+        )
+        assert run_in_memory(rule, {"mod.py": src}) == ([], [])
+        # ... but a non-comment line breaks the block
+        src2 = "# lint-ok: fake(why)\ny = 1\nx = BAD\n"
+        new, _ = run_in_memory(rule, {"mod.py": src2})
+        assert len(new) == 1
+
+    def test_waiver_requires_a_why(self):
+        rule = self._fake_rule()
+        new, _ = run_in_memory(
+            rule, {"mod.py": "x = BAD  # lint-ok: fake()\n"}
+        )
+        assert len(new) == 1, "an empty why must not waive"
+
+    def test_fingerprint_survives_line_drift(self):
+        rule = self._fake_rule()
+        (d1, fp1), = run_in_memory(rule, {"mod.py": "x = BAD\n"})[0]
+        (d2, fp2), = run_in_memory(
+            rule, {"mod.py": "# pushed down\n\n\nx = BAD\n"}
+        )[0]
+        assert d1.line != d2.line and fp1 == fp2, (
+            "baseline identity hashes the source line, not its number"
+        )
+
+    def test_run_rules_on_disk(self, tmp_path):
+        (tmp_path / "mod.py").write_text("x = BAD\n")
+        rule = self._fake_rule()
+        result = run_rules([rule], str(tmp_path), baseline=[])
+        assert result.failed and result.per_rule["fake"] == 1
+        fp = result.new[0][1]
+        result2 = run_rules([rule], str(tmp_path), baseline=[fp])
+        assert not result2.failed and len(result2.suppressed) == 1
+        # stale entries are reported, never fatal — but only for rules
+        # that actually ran (a --rule subset must not cry stale about
+        # entries belonging to the rules it skipped)
+        result3 = run_rules(
+            [rule], str(tmp_path), baseline=[fp, "zz|fake|dead", "zz|other|x"]
+        )
+        assert result3.stale_baseline == ["zz|fake|dead"]
+
+
+# ---------------------------------------------------------------------------
+# host-sync (migrated pass; the script-level surface is pinned by
+# tests/test_telemetry.py — here: the framework integration)
+
+
+class TestHostSyncPass:
+    def test_flags_and_both_annotation_spellings(self):
+        src = (
+            "def hot(m):\n"
+            "    a = float(m['loss'])\n"
+            "    b = float(m['x'])  # host-sync-ok: host int\n"
+            "    c = float(m['y'])  # lint-ok: host-sync(host int)\n"
+            "    return a, b, c\n"
+        )
+        findings = host_sync.scan_source(src, set(), "x.py")
+        assert len(findings) == 1 and findings[0][0] == 2
+
+    def test_rule_scans_its_module_list(self):
+        rule = host_sync.HostSyncRule()
+        bad = "def anywhere(m):\n    return float(m)\n"
+        new, _ = run_in_memory(
+            rule, {"dotaclient_tpu/train/snapshot.py": bad}
+        )
+        assert len(new) == 1 and "float()" in new[0][0].message
+        # allowed function in an ALLOWED_FUNCS module stays clean
+        ok = "def restore(m):\n    return float(m)\n"
+        assert run_in_memory(
+            rule, {"dotaclient_tpu/utils/checkpoint.py": ok}
+        ) == ([], [])
+
+
+# ---------------------------------------------------------------------------
+# use-after-donate
+
+
+DONATE_HEADER = "import jax\nstep = jax.jit(lambda s, b: s, donate_argnums=(0,))\n"
+
+
+class TestUseAfterDonate:
+    def _analyze(self, body, factories=None):
+        ctx = FileCtx("dotaclient_tpu/x.py", DONATE_HEADER + body)
+        return donation.analyze_module(ctx, factories or {})
+
+    def test_flags_read_after_donate(self):
+        """The TPU-silent-corruption repro: works on the CPU sandbox,
+        corrupts on hardware — only the lint can catch it."""
+        out = self._analyze(
+            "def train(state, batch):\n"
+            "    new_state, m = step(state, batch)\n"
+            "    return new_state, state.loss\n"
+        )
+        assert len(out) == 1
+        assert "state.loss" in out[0].message and "donated" in out[0].message
+
+    def test_rebind_in_statement_is_the_idiom(self):
+        out = self._analyze(
+            "def train(state, batch):\n"
+            "    state, m = step(state, batch)\n"
+            "    return state.params\n"
+        )
+        assert out == []
+
+    def test_later_rebind_ends_the_taint(self):
+        out = self._analyze(
+            "def train(state, batch, fresh):\n"
+            "    out = step(state, batch)\n"
+            "    state = fresh\n"
+            "    return state.params\n"
+        )
+        assert out == []
+
+    def test_attribute_extension_flags(self):
+        """Donating `self.state` kills `self.state.params` too."""
+        src = (
+            "import jax\n"
+            "class L:\n"
+            "    def __init__(self):\n"
+            "        self.step = jax.jit(f, donate_argnums=(0,))\n"
+            "    def bad(self, batch):\n"
+            "        out, m = self.step(self.state, batch)\n"
+            "        return self.state.params\n"
+        )
+        out = donation.analyze_module(FileCtx("dotaclient_tpu/x.py", src), {})
+        assert len(out) == 1 and "self.state.params" in out[0].message
+
+    def test_factory_registry_cross_module(self):
+        maker = (
+            "import jax\n"
+            "def make_step(f):\n"
+            "    fn = jax.jit(f, donate_argnums=(0,))\n"
+            "    return fn\n"
+        )
+        user = (
+            "from m import make_step\n"
+            "step = make_step(None)\n"
+            "def train(s, b):\n"
+            "    s2 = step(s, b)\n"
+            "    return s.x\n"
+        )
+        files = {
+            "dotaclient_tpu/m.py": FileCtx("dotaclient_tpu/m.py", maker),
+            "dotaclient_tpu/u.py": FileCtx("dotaclient_tpu/u.py", user),
+        }
+        registry = donation.build_factory_registry(files)
+        assert registry.get("make_step") == (0,)
+        out = donation.analyze_module(files["dotaclient_tpu/u.py"], registry)
+        assert len(out) == 1 and "'s.x'" in out[0].message
+
+    def test_real_factories_are_registered(self):
+        """The live registry must know the real donating factories —
+        otherwise the pass is vacuous on the code that matters."""
+        files = {}
+        for rel in (
+            "dotaclient_tpu/train/ppo.py",
+        ):
+            with open(os.path.join(REPO_ROOT, rel)) as f:
+                files[rel] = FileCtx(rel, f.read())
+        registry = donation.build_factory_registry(files)
+        assert registry.get("make_train_step") == (0,)
+        assert registry.get("make_epoch_step") == (0,)
+
+    def test_untrackable_donation_specs_flag_at_definition(self):
+        """A donation the pass cannot position-track must say so — silent
+        blindness to a donating callable is worse than any false
+        positive (review finding: `donate_argnums=DONATE` used to slip
+        through with no taint AND no diagnostic)."""
+        for spec in (
+            "donate_argnums=DONATE",
+            "donate_argnums=(0, N)",
+            "donate_argnames=('state',)",
+        ):
+            src = (
+                f"import jax\n"
+                f"step = jax.jit(run, {spec})\n"
+                f"def train(state, batch):\n"
+                f"    out = step(state, batch)\n"
+                f"    return state.params\n"
+            )
+            out = donation.analyze_module(
+                FileCtx("dotaclient_tpu/x.py", src), {}
+            )
+            assert out and "not statically trackable" in out[0].message, spec
+
+    def test_waiver(self):
+        rule = donation.UseAfterDonateRule()
+        src = (
+            DONATE_HEADER
+            + "def train(state, batch):\n"
+            + "    out = step(state, batch)\n"
+            + "    # lint-ok: use-after-donate(read races the dispatch on\n"
+            + "    # purpose in this debug-only helper)\n"
+            + "    return state.loss\n"
+        )
+        new, _ = run_in_memory(rule, {"dotaclient_tpu/x.py": src})
+        assert new == []
+
+
+# ---------------------------------------------------------------------------
+# thread-ownership — the three PR 5-6 race shapes are the fixtures
+
+
+RACE_MAP = {
+    "Learner": ownership.ClassMap(
+        default_thread="train",
+        methods={"_finish_metrics": "engine"},
+        attrs={
+            "_pending_best": "lock:_pending_best_lock",
+            "_last_verdict_m": "train",
+            "_monitor_state": "lock:_lock",
+        },
+        holds={"_fold_locked": ("_lock",)},
+    ),
+}
+
+
+def scan_race(src):
+    return ownership.scan_source_with_map("x.py", src, RACE_MAP)
+
+
+class TestThreadOwnership:
+    def test_race_shape_pending_best_unlocked_swap(self):
+        """PR 5 race: the snapshot thread's metrics continuation wrote
+        _pending_best while the train thread read-and-cleared it — an
+        unsynchronized swap could drop a qualifying peak. The fixed code
+        holds _pending_best_lock on both sides; the unlocked shape must
+        flag."""
+        bad = (
+            "class Learner:\n"
+            "    def _finish_metrics(self, scalars):\n"
+            "        self._pending_best = dict(scalars)\n"
+        )
+        out = scan_race(bad)
+        assert len(out) == 1 and "_pending_best_lock" in out[0].message
+        good = (
+            "class Learner:\n"
+            "    def _finish_metrics(self, scalars):\n"
+            "        with self._pending_best_lock:\n"
+            "            self._pending_best = dict(scalars)\n"
+        )
+        assert scan_race(good) == []
+
+    def test_race_shape_last_verdict_cross_thread(self):
+        """PR 6 race: _last_verdict_m is train-owned sync-gate state
+        (cleared by rollback, folded by sync boundaries); any engine-
+        thread touch is the regression shape."""
+        bad = (
+            "class Learner:\n"
+            "    def _finish_metrics(self, scalars):\n"
+            "        self._last_verdict_m = None\n"
+        )
+        out = scan_race(bad)
+        assert len(out) == 1
+        assert "train thread" in out[0].message
+        assert "engine thread" in out[0].message
+
+    def test_race_shape_sync_gate_fold_outside_lock(self):
+        """PR 6 race: the sync-mode gate folded verdicts on knowledge read
+        outside the monitor's lock — lock-guarded attrs accessed outside
+        `with self._lock:` must flag; the holds= contract (the *_locked
+        helper convention) and the with-block both satisfy it."""
+        bad = (
+            "class Learner:\n"
+            "    def gate(self):\n"
+            "        return self._monitor_state\n"
+        )
+        assert len(scan_race(bad)) == 1
+        good = (
+            "class Learner:\n"
+            "    def gate(self):\n"
+            "        with self._lock:\n"
+            "            return self._monitor_state\n"
+            "    def _fold_locked(self):\n"
+            "        return self._monitor_state\n"
+        )
+        assert scan_race(good) == []
+
+    def test_closure_resolves_to_innermost_declared_def(self):
+        src = (
+            "class Learner:\n"
+            "    def _make(self):\n"
+            "        def _finish_metrics(host):\n"
+            "            self._last_verdict_m = host\n"
+            "        return _finish_metrics\n"
+        )
+        out = scan_race(src)
+        assert len(out) == 1, "the nested engine-thread def must not hide"
+
+    def test_init_is_exempt(self):
+        src = (
+            "class Learner:\n"
+            "    def __init__(self):\n"
+            "        self._pending_best = None\n"
+            "        self._monitor_state = {}\n"
+        )
+        assert scan_race(src) == []
+
+    def test_waiver(self):
+        src = (
+            "class Learner:\n"
+            "    def _finish_metrics(self, s):\n"
+            "        # lint-ok: thread-ownership(handoff after barrier)\n"
+            "        self._last_verdict_m = s\n"
+        )
+        rule = ownership.ThreadOwnershipRule()
+        files = {"x.py": FileCtx("x.py", src)}
+        diags = ownership.scan_source_with_map("x.py", src, RACE_MAP)
+        assert diags, "sanity: the access itself flags"
+        assert files["x.py"].waived(diags[0].line, "thread-ownership")
+
+    def test_shipped_map_covers_the_mandated_classes(self):
+        """ISSUE 9 names the surfaces: Learner, SnapshotEngine,
+        HealthMonitor, both transports."""
+        declared = {
+            cls for maps in ownership.OWNERSHIP.values() for cls in maps
+        }
+        for cls in (
+            "Learner",
+            "SnapshotEngine",
+            "HealthMonitor",
+            "TransportServer",
+            "ShmTransportServer",
+        ):
+            assert cls in declared, f"{cls} missing from OWNERSHIP"
+
+
+# ---------------------------------------------------------------------------
+# telemetry-drift
+
+
+class TestTelemetryDrift:
+    def _emit(self, src):
+        files = {"dotaclient_tpu/x.py": FileCtx("dotaclient_tpu/x.py", src)}
+        return telemetry_drift.extract_emitted(files)
+
+    def test_extraction_idioms(self):
+        src = (
+            "class T:\n"
+            "    def go(self):\n"
+            "        self._tel.counter('a/one').inc()\n"
+            "        with self._tel.span('b/two'):\n"
+            "            pass\n"
+            "        for key in ('c/three', 'c/four'):\n"
+            "            self._tel.gauge(key)\n"
+            "        for k in kinds:\n"
+            "            self._tel.counter(f'snapshot/{k}_coalesced')\n"
+        )
+        keys, _sites, problems = self._emit(src)
+        assert keys == {
+            "a/one", "span/b/two", "c/three", "c/four",
+            "snapshot/publish_coalesced", "snapshot/checkpoint_coalesced",
+            "snapshot/metrics_coalesced",
+        }
+        assert problems == []
+
+    def test_unresolvable_key_flags(self):
+        keys, _sites, problems = self._emit(
+            "def go(tel, name):\n    tel.counter(f'x/{name}_total')\n"
+        )
+        assert keys == set() and len(problems) == 1
+        assert "not statically resolvable" in problems[0].message
+
+    def test_doc_key_extraction(self):
+        doc = (
+            "Keys: `transport/queue_depth`, the set "
+            "`buffer/dropped_{overflow,stale}`, spans `actor/collect`, "
+            "wildcards `league/eval_*` and `snapshot/<kind>_coalesced`; "
+            "not keys: `envs/lane_sim.py`, `obs/hero_id`, `deploy/`.\n"
+        )
+        exact, patterns = telemetry_drift.extract_doc_keys(doc)
+        assert exact == {
+            "transport/queue_depth", "buffer/dropped_overflow",
+            "buffer/dropped_stale", "actor/collect",
+        }
+        assert any(p.match("league/eval_win_rate") for p in patterns)
+        assert any(p.match("snapshot/metrics_coalesced") for p in patterns)
+
+    def test_drift_directions(self):
+        emitted = {"transport/queue_depth", "span/actor/collect", "x/rogue"}
+        sites = [(k, 1, "dotaclient_tpu/x.py") for k in emitted]
+        doc = "`transport/queue_depth` `actor/collect` `transport/ghost`\n"
+        tiers = {"FAKE_KEYS": ["transport/queue_depth", "buffer/never"]}
+        out = telemetry_drift.drift_findings(emitted, sites, doc, tiers)
+        msgs = "\n".join(d.message for d in out)
+        assert "'buffer/never' is required by schema tier FAKE_KEYS" in msgs
+        assert "'transport/ghost' is documented" in msgs
+        assert "'x/rogue' is emitted" in msgs
+        # the satisfied keys produce no findings
+        contexts = {d.context for d in out}
+        assert "transport/queue_depth" not in contexts
+        assert "span/actor/collect" not in contexts
+
+    def test_span_leaf_tier_keys_resolve_to_roots(self):
+        emitted = {"span/learner/dispatch"}
+        tiers = {"REQUIRED_KEYS": ["span/learner/dispatch/mean_s"]}
+        out = telemetry_drift.drift_findings(
+            emitted, [], "`learner/dispatch`\n", tiers
+        )
+        assert out == []
+
+    def test_reverting_pr7_doc_additions_fails_the_pass(self):
+        """Acceptance criterion: strip the quantized-experience-plane key
+        documentation (the PR 7 additions) from the REAL ARCHITECTURE.md
+        and the drift pass must fail on the real emitted set."""
+        rule = telemetry_drift.TelemetryDriftRule()
+        files = {}
+        for rel in rule.paths():
+            path = os.path.join(REPO_ROOT, rel)
+            if os.path.exists(path):
+                with open(path) as f:
+                    files[rel] = FileCtx(rel, f.read())
+        # sanity: the real tree is clean
+        assert rule.check(files) == []
+        doc = files[telemetry_drift.ARCHITECTURE_MD]
+        stripped = "\n".join(
+            line
+            for line in doc.source.splitlines()
+            if "transport/rollout_" not in line
+        )
+        files[telemetry_drift.ARCHITECTURE_MD] = FileCtx(
+            telemetry_drift.ARCHITECTURE_MD, stripped
+        )
+        findings = rule.check(files)
+        flagged = {d.context for d in findings}
+        assert {
+            "transport/rollout_bytes_total",
+            "transport/rollout_raw_bytes_total",
+            "transport/rollout_compression_ratio",
+        } <= flagged, "undocumenting the PR 7 keys must fail the pass"
+
+
+# ---------------------------------------------------------------------------
+# config-drift
+
+
+CFG_SRC = (
+    "import dataclasses\n"
+    "@dataclasses.dataclass(frozen=True)\n"
+    "class BufferConfig:\n"
+    "    capacity: int = 4\n"
+    "    min_fill: int = 2\n"
+)
+
+CLI_SRC = (
+    "def main():\n"
+    "    import argparse\n"
+    "    p = argparse.ArgumentParser()\n"
+    "    p.add_argument('--steps', type=int)\n"
+    "    p.add_argument('--buffer', type=str)\n"
+)
+
+
+class TestConfigDrift:
+    def test_extractors(self):
+        assert config_drift.dataclass_fields(CFG_SRC) == {
+            "BufferConfig": ["capacity", "min_fill"]
+        }
+        assert config_drift.cli_flags(CLI_SRC) == {"--steps", "--buffer"}
+        doc = (
+            "Run with `--steps 5` or --buffer k=v; env "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 is not a "
+            "flag, nor is ---rule.\n"
+        )
+        flags = config_drift.documented_flags(doc)
+        assert set(flags) == {"--steps", "--buffer"}
+
+    def test_knob_table_parsing(self):
+        doc = (
+            "### `--buffer` (BufferConfig)\n\n"
+            "| knob | default | what |\n|---|---|---|\n"
+            "| `capacity` | 4 | slots |\n"
+            "| `min_fill` | 2 | gate |\n"
+        )
+        tables = config_drift.knob_tables(doc)
+        assert tables["--buffer"][0] == "BufferConfig"
+        assert set(tables["--buffer"][1]) == {"capacity", "min_fill"}
+
+    def test_knob_table_closed_by_next_heading(self):
+        """A later unrelated backticked-first-column table must not be
+        misattributed to the last knob table (review finding)."""
+        doc = (
+            "### `--buffer` (BufferConfig)\n\n"
+            "| knob | default | what |\n|---|---|---|\n"
+            "| `capacity` | 4 | slots |\n"
+            "\n## Some later section\n\n"
+            "| `some_metric` | 1 |\n"
+        )
+        tables = config_drift.knob_tables(doc)
+        assert set(tables["--buffer"][1]) == {"capacity"}
+
+    def _drift(self, doc):
+        fields = config_drift.dataclass_fields(CFG_SRC)
+        flags = {
+            "dotaclient_tpu/train/learner.py": config_drift.cli_flags(
+                CLI_SRC
+            ),
+        }
+        return config_drift.drift_findings(fields, flags, doc)
+
+    def test_missing_and_stale_knob_rows(self):
+        doc = (
+            "`--steps` `--buffer`\n"
+            "### `--buffer` (BufferConfig)\n\n"
+            "| knob | default | what |\n|---|---|---|\n"
+            "| `capacity` | 4 | slots |\n"
+            "| `renamed_away` | 0 | gone |\n"
+        )
+        msgs = "\n".join(d.message for d in self._drift(doc))
+        assert "BufferConfig.min_fill is reachable" in msgs
+        assert "'renamed_away' but BufferConfig has no such field" in msgs
+
+    def test_documented_flag_must_exist(self):
+        doc = (
+            "`--steps` `--buffer` `--does-not-exist`\n"
+            "### `--buffer` (BufferConfig)\n\n"
+            "| knob | default | what |\n|---|---|---|\n"
+            "| `capacity` | 4 | slots |\n"
+            "| `min_fill` | 2 | gate |\n"
+        )
+        msgs = "\n".join(d.message for d in self._drift(doc))
+        assert "--does-not-exist" in msgs and "no entrypoint" in msgs
+
+    def test_operator_cli_flags_must_be_documented(self):
+        doc = (
+            "`--buffer`\n"
+            "### `--buffer` (BufferConfig)\n\n"
+            "| knob | default | what |\n|---|---|---|\n"
+            "| `capacity` | 4 | slots |\n"
+            "| `min_fill` | 2 | gate |\n"
+        )
+        msgs = "\n".join(d.message for d in self._drift(doc))
+        assert "--steps is defined by dotaclient_tpu/train/learner.py" in msgs
+
+    def test_learner_override_flag_parses(self):
+        """The --learner K=V surface the pass documents must actually
+        parse (satellite: LearnerConfig joined the override family)."""
+        from dotaclient_tpu.config import LearnerConfig
+        from dotaclient_tpu.utils.overrides import parse_dataclass_overrides
+
+        out = parse_dataclass_overrides(
+            LearnerConfig, "async_snapshots=false,snapshot_drain_timeout_s=5",
+            "--learner",
+        )
+        assert out == {
+            "async_snapshots": False, "snapshot_drain_timeout_s": 5.0,
+        }
+        with pytest.raises(ValueError, match="--learner"):
+            parse_dataclass_overrides(LearnerConfig, "nope=1", "--learner")
+
+
+# ---------------------------------------------------------------------------
+# tier-1 wrapper — the acceptance criterion
+
+
+class TestTier1Wrapper:
+    def test_lint_clean_on_head(self, capsys):
+        """`python -m dotaclient_tpu.lint` exits 0 on HEAD with every pass
+        active. Non-strict by default; LINT_STRICT=1 escalates to --strict
+        (baseline debt fails too), the TIER1_DURATION_STRICT pattern."""
+        from dotaclient_tpu.lint.__main__ import main
+
+        argv = ["--strict"] if os.environ.get("LINT_STRICT") == "1" else []
+        rc = main(argv)
+        out = capsys.readouterr()
+        assert rc == 0, f"graftlint failed on HEAD:\n{out.err}"
+        assert len(ALL_RULES) >= 4, "ISSUE 9 mandates >= 4 passes"
+        assert "graftlint OK" in out.out
+
+    def test_baseline_file_is_tracked_and_loadable(self):
+        path = os.path.join(REPO_ROOT, "dotaclient_tpu/lint/baseline.txt")
+        entries = load_baseline(path)
+        for fp in entries:
+            assert fp.count("|") == 2, f"malformed baseline entry {fp!r}"
+
+    def test_rule_subset_update_preserves_other_rules_entries(self, tmp_path):
+        """--rule X --update-baseline must not wipe other rules' baseline
+        blocks or their tracking comments (review finding: it rewrote the
+        file from only the selected rules' findings)."""
+        from dotaclient_tpu.lint.core import (
+            baseline_rule,
+            load_baseline_blocks,
+            write_baseline,
+        )
+
+        path = str(tmp_path / "baseline.txt")
+        write_baseline(
+            path,
+            [
+                (
+                    "a.py|kept-rule|aaaaaaaaaaaa",
+                    Diagnostic("a.py", 1, "kept-rule", "kept finding"),
+                ),
+                (
+                    "b.py|run-rule|bbbbbbbbbbbb",
+                    Diagnostic("b.py", 2, "run-rule", "regenerated"),
+                ),
+            ],
+        )
+        blocks = load_baseline_blocks(path)
+        assert [fp for _c, fp in blocks] == [
+            "a.py|kept-rule|aaaaaaaaaaaa", "b.py|run-rule|bbbbbbbbbbbb",
+        ]
+        # simulate `--rule run-rule --update-baseline` finding nothing:
+        # the kept-rule block (comment included) must survive verbatim
+        preserved = [
+            (c, fp) for c, fp in blocks if baseline_rule(fp) != "run-rule"
+        ]
+        write_baseline(path, [], preserved=preserved)
+        blocks2 = load_baseline_blocks(path)
+        assert [fp for _c, fp in blocks2] == ["a.py|kept-rule|aaaaaaaaaaaa"]
+        assert any("kept finding" in c for c in blocks2[0][0])
+
+    def test_rule_catalog_lists_all_passes(self, capsys):
+        from dotaclient_tpu.lint.__main__ import main
+
+        assert main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for cls in ALL_RULES:
+            assert cls.id in out
+
+    def test_single_rule_selection(self, capsys):
+        from dotaclient_tpu.lint.__main__ import main
+
+        assert main(["--rule", "host-sync"]) == 0
+        assert "[rules: host-sync]" in capsys.readouterr().out
