@@ -63,12 +63,16 @@ class TrajectoryBuffer:
         self.config = config
         self.mesh = mesh
         self._tel = registry if registry is not None else telemetry.get_registry()
-        from dotaclient_tpu.parallel.mesh import batch_axes, data_sharding
+        from dotaclient_tpu.parallel.mesh import (
+            batch_axes,
+            batch_shard_count,
+            data_sharding,
+            replicated,
+        )
 
         axes = batch_axes(mesh, config.mesh)
-        n_data = 1
-        for a in axes:
-            n_data *= mesh.shape[a]
+        n_data = batch_shard_count(mesh, config.mesh)
+        self._n_shards = n_data
         desc = "×".join(f"{a}={mesh.shape[a]}" for a in axes)
         cap = config.buffer.capacity_rollouts
         if cap % n_data:
@@ -187,6 +191,17 @@ class TrajectoryBuffer:
         self._store = jax.tree.map(
             lambda x: jax.device_put(x, self._sharding), template
         )
+        # Multi-chip residency accounting (ISSUE 10): the ring is
+        # batch-sharded, so each device holds 1/n_data of every leaf —
+        # `buffer/shard_bytes` is the PER-DEVICE resident HBM cost of the
+        # ring (the number an operator sizes capacity_rollouts against).
+        total_bytes = sum(
+            x.size * np.dtype(x.dtype).itemsize
+            for x in jax.tree.leaves(template)
+        )
+        self._tel.gauge("buffer/shard_bytes").set(
+            float(total_bytes // n_data)
+        )
         # Host-side bookkeeping: consumption order is an explicit deque of
         # slot ids (oldest first) plus a free list — NOT ring-cursor
         # arithmetic. Chunk versions are not monotone in ship order (an
@@ -234,14 +249,16 @@ class TrajectoryBuffer:
         self._staging_lanes = max(1, config.buffer.staging_slots)
         self._staging: Optional[List[Any]] = None
         self._staging_idx = 0
-        # Host ingest pads to power-of-two row counts (see add()), so the
-        # lanes must hold the padded form of a full-capacity ingest.
-        self._staging_rows = _pow2ceil(cap)
+        # Host ingest pads to shard-divisible power-of-two buckets (see
+        # _pad_rows), so the lanes must hold the padded form of a
+        # full-capacity ingest (monotone in n, so the cap is the max).
+        self._staging_rows = self._pad_rows(cap)
 
         # Retrace accounting (ADVICE round 1): every distinct rows leading
-        # dim compiles one XLA program. Host ingest pads to powers of two
-        # and the device path scatters pow2 chunks, so the program set is
-        # bounded at log2(capacity)+1 — `scatter_traces` proves it.
+        # dim compiles one XLA program. Host ingest pads to shard-divisible
+        # pow2 buckets and the device path scatters pow2 chunks, so the
+        # program set per path is bounded at log2(capacity)+1 —
+        # `scatter_traces` proves it.
         self.scatter_traces = 0
 
         def _scatter_impl(store, rows, idx):
@@ -254,10 +271,35 @@ class TrajectoryBuffer:
                 lambda s, r: s.at[idx].set(r.astype(s.dtype)), store, rows
             )
 
+        store_shardings = jax.tree.map(lambda _: self._sharding, template)
+        # HOST ingest path: rows are numpy staging-lane views, and the
+        # explicit data-sharded in_shardings makes the H2D transfer land
+        # DIRECTLY in each device's shard — 1/n_data of the group's bytes
+        # per device. Without it the compiler replicates uncommitted host
+        # inputs: every device received a FULL copy of every ingest group
+        # (n_devices × the bytes; measured via compiled input shardings) —
+        # the single-device-memory scatter ISSUE 10 exists to fix.
+        # _pad_rows guarantees the leading dim divides by n_data.
         self._scatter = jax.jit(
             _scatter_impl,
             donate_argnums=(0,),
-            out_shardings=jax.tree.map(lambda _: self._sharding, template),
+            in_shardings=(
+                store_shardings,
+                jax.tree.map(lambda _: self._sharding, template),
+                replicated(mesh),
+            ),
+            out_shardings=store_shardings,
+        )
+        # DEVICE ingest path (add_device): rows are committed slices of an
+        # in-process chunk (whatever sharding the producing program left
+        # them with — explicit in_shardings would REJECT them, jax refuses
+        # committed args whose sharding mismatches); no H2D happens here,
+        # the program reshards in HBM. Separate jit so the two paths'
+        # programs never mix; same impl, same trace bound.
+        self._scatter_dev = jax.jit(
+            _scatter_impl,
+            donate_argnums=(0,),
+            out_shardings=store_shardings,
         )
         # Consume-time upcast (ISSUE 7): the gather restores the train
         # dtypes in the same jitted program — the only place narrow rows
@@ -269,6 +311,17 @@ class TrajectoryBuffer:
             ),
             out_shardings=jax.tree.map(lambda _: self._sharding, template),
         )
+
+    def _pad_rows(self, n: int) -> int:
+        """Padded row count for a host ingest group of ``n`` rows: the
+        smallest power-of-two-per-shard multiple of the batch shard count
+        that covers ``n``. With one shard this is exactly the historical
+        pow2 bucket; with n_data shards it additionally guarantees the
+        sharded scatter's leading dim divides evenly (jax rejects a
+        NamedSharding whose axis does not divide). Distinct values stay
+        bounded at log2(capacity/n_data)+1, so the retrace bound holds."""
+        per_shard = -(-max(1, n) // self._n_shards)
+        return _pow2ceil(per_shard) * self._n_shards
 
     # -- properties --------------------------------------------------------
 
@@ -362,16 +415,17 @@ class TrajectoryBuffer:
                     self._publish_telemetry()
                     return 0
             n = len(fresh)
-            # Pad the ingest group to a power-of-two bucket and scatter ONCE
-            # (ADVICE round 1): a varying leading dim would compile one XLA
-            # program per distinct count — up to `capacity` of them. Pad
-            # rows are copies of the LAST REAL ROW and their indices
-            # duplicate its slot, so the duplicate writes are identical
-            # (order-independent) and the pad never enters the slot
-            # bookkeeping below. Bounds the program set at log2(capacity)+1
-            # (asserted via `scatter_traces` in tests). numpy rows transfer
-            # on the dispatch path (no separate synchronizing device_put).
-            n_pad = _pow2ceil(n)
+            # Pad the ingest group to a shard-divisible power-of-two bucket
+            # and scatter ONCE (ADVICE round 1): a varying leading dim
+            # would compile one XLA program per distinct count — up to
+            # `capacity` of them. Pad rows are copies of the LAST REAL ROW
+            # and their indices duplicate its slot, so the duplicate writes
+            # are identical (order-independent) and the pad never enters
+            # the slot bookkeeping below. Bounds the program set at
+            # log2(capacity/n_data)+1 (asserted via `scatter_traces` in
+            # tests). numpy rows transfer on the dispatch path, sharded —
+            # each device receives only its slice (see _scatter).
+            n_pad = self._pad_rows(n)
             rows = self._stage_rows(
                 [arrays for _, arrays in fresh], pad_to=n_pad
             )
@@ -532,7 +586,10 @@ class TrajectoryBuffer:
             while remaining:
                 n = 1 << (remaining.bit_length() - 1)
                 rows = jax.tree.map(lambda r: r[pos:pos + n], chunk)
-                self._store = self._scatter(self._store, rows, idx[pos:pos + n])
+                # device-path scatter: rows keep their producer's sharding
+                self._store = self._scatter_dev(
+                    self._store, rows, idx[pos:pos + n]
+                )
                 pos += n
                 remaining -= n
             self._slot_version[idx] = version

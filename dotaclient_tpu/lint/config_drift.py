@@ -41,6 +41,7 @@ OVERRIDE_FLAGS: Dict[str, str] = {
     "--buffer": "BufferConfig",
     "--health": "HealthConfig",
     "--learner": "LearnerConfig",
+    "--mesh": "MeshConfig",
 }
 
 # CLIs whose full flag surface must be documented in OPERATIONS.md
@@ -54,6 +55,7 @@ ALL_CLIS = OPERATOR_CLIS + (
     "dotaclient_tpu/league/__main__.py",
     "dotaclient_tpu/lint/__main__.py",
     "scripts/chaos_run.py",
+    "scripts/run_multichip.py",
     "scripts/train_demo.py",
     "scripts/curriculum_5v5.py",
     "scripts/bench_configs.py",

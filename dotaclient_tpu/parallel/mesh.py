@@ -40,16 +40,25 @@ def make_mesh(
     slice-major (all of slice 0, then slice 1, ...), so reshaping to
     ``(dcn, data, model)`` puts each slice's devices in one dcn index and
     keeps the model axis on ICI neighbors.
+
+    An EXPLICIT layout (``data_parallel > 0``) smaller than the visible
+    device set takes the first ``dcn×data×model`` devices: a 1-device mesh
+    in an 8-device process is the degenerate case of the one sharded code
+    path (`--mesh data_parallel=1`), not a separate fork — the parity
+    probes in bench.py's multichip stage and tests/test_multichip.py
+    depend on both sizes coexisting in one process.
     """
     devices = list(devices if devices is not None else jax.devices())
     model = max(1, config.model_parallel)
     dcn = max(1, config.dcn_slices)
+    data = config.data_parallel
+    if data > 0 and dcn * data * model < len(devices):
+        devices = devices[: dcn * data * model]
     if len(devices) % (model * dcn):
         raise ValueError(
             f"{len(devices)} devices not divisible by "
             f"dcn_slices×model_parallel={dcn}x{model}"
         )
-    data = config.data_parallel
     if data == -1:
         data = len(devices) // (model * dcn)
     if dcn * data * model != len(devices):
@@ -74,6 +83,17 @@ def batch_axes(mesh: Mesh, config: MeshConfig) -> Tuple[str, ...]:
     return tuple(axes)
 
 
+def batch_shard_count(mesh: Mesh, config: MeshConfig) -> int:
+    """How many ways the batch dimension splits over this mesh — the
+    divisibility unit for batch sizes, buffer capacity, and ingest-group
+    padding. Shared by the learner and the trajectory buffer so their
+    checks cannot drift."""
+    n = 1
+    for a in batch_axes(mesh, config):
+        n *= mesh.shape[a]
+    return n
+
+
 def data_sharding(mesh: Mesh, config: MeshConfig) -> NamedSharding:
     """Batch-sharded over the (dcn×)data axes (leading dimension)."""
     return NamedSharding(mesh, P(batch_axes(mesh, config)))
@@ -81,3 +101,31 @@ def data_sharding(mesh: Mesh, config: MeshConfig) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def collective_probe_ms(mesh: Mesh, config: MeshConfig) -> float:
+    """Measure one cross-mesh all-reduce round trip (dispatch → replicated
+    result on the host), in milliseconds.
+
+    A one-time STARTUP probe (the ``learner/psum_ms`` gauge): the train
+    path itself never blocks on its gradient psum — XLA fuses it into the
+    dispatched step — so the per-step collective cost is not separably
+    observable without a profiler. This measures the same collective shape
+    (one scalar per batch shard, summed to a replicated scalar) cold-path,
+    which bounds the mesh's reduce latency floor. On a 1-device mesh it
+    degenerates to dispatch+fetch latency. Deliberately blocking — call it
+    at construction, never from the train loop.
+    """
+    import time
+
+    import jax.numpy as jnp
+
+    n = batch_shard_count(mesh, config)
+    xs = jax.device_put(
+        np.ones((n,), np.float32), data_sharding(mesh, config)
+    )
+    fn = jax.jit(lambda x: jnp.sum(x), out_shardings=replicated(mesh))
+    fn(xs).block_until_ready()   # compile outside the measurement
+    t0 = time.perf_counter()
+    fn(xs).block_until_ready()   # host-sync-ok: one-time startup probe
+    return (time.perf_counter() - t0) * 1e3

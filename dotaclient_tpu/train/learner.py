@@ -166,15 +166,34 @@ class Learner:
         self.actor_mode = mode
         self.config = config
         self.mesh = make_mesh(config.mesh)
+        # Multi-chip telemetry (ISSUE 10): mesh geometry gauges plus a
+        # ONE-TIME startup probe of the mesh's all-reduce round trip
+        # (`learner/psum_ms`) — the per-step gradient psum is fused into
+        # the dispatched program and never separably observable, so the
+        # probe is the documented stand-in. All eager-created here so any
+        # learner run's JSONL validates
+        # `check_telemetry_schema.py --require-multichip`
+        # deterministically (`buffer/shard_bytes` stays 0 for bufferless
+        # fused runs; the ring overwrites it when it allocates).
+        from dotaclient_tpu.parallel.mesh import (
+            batch_shard_count,
+            collective_probe_ms,
+        )
+
+        reg = telemetry.get_registry()
+        reg.gauge("mesh/n_devices").set(float(self.mesh.devices.size))
+        reg.gauge("mesh/data_shards").set(
+            float(batch_shard_count(self.mesh, config.mesh))
+        )
+        reg.gauge("buffer/shard_bytes")
+        reg.gauge("learner/psum_ms").set(
+            collective_probe_ms(self.mesh, config.mesh)
+        )
         if config.ppo.minibatches > 1:
             # each minibatch is itself a data-sharded train batch. In fused
             # mode the chunk IS the lane set, split along lanes in-program
             # (train/fused.py); the buffered paths split batch_rollouts.
-            from dotaclient_tpu.parallel.mesh import batch_axes
-
-            shards = 1
-            for a in batch_axes(self.mesh, config.mesh):
-                shards *= self.mesh.shape[a]
+            shards = batch_shard_count(self.mesh, config.mesh)
             if mode == "fused":
                 from dotaclient_tpu.actor.device_rollout import lane_split
 
@@ -194,6 +213,17 @@ class Learner:
         self.policy = make_policy(config.model, config.obs, config.actions)
         params = init_params(self.policy, jax.random.PRNGKey(config.seed))
         self.state = init_train_state(params, config.ppo)
+        # The TrainState's sharding tree (params + Adam mirrors replicated
+        # under pure DP, TP-partitioned under model_parallel > 1; counters
+        # replicated) — the SAME tree make_train_step/make_epoch_step pin
+        # as in/out shardings, computed once and reused by every restore
+        # path so a checkpoint written at a different device count is
+        # re-committed to THIS mesh before its first dispatch (ISSUE 10).
+        from dotaclient_tpu.train.ppo import train_state_sharding
+
+        self.state_shardings = train_state_sharding(
+            self.policy, config, self.mesh
+        )
         self.ckpt: Optional[CheckpointManager] = None
         self._want_restore = restore
         self._init_from_step = 0   # source step when seeded via init_from
@@ -305,6 +335,15 @@ class Learner:
                             flush=True,
                         )
                         self._best_win = float("inf")
+        # Commit the state to the mesh NOW (one device_put against
+        # state_shardings), whatever path built it — fresh init, init_from
+        # seed, or a --restore of a checkpoint written at ANY device count
+        # (restores hand back host-layout arrays; this is the re-shard).
+        # Committing before the first dispatch also means the first donated
+        # step donates correctly-sharded buffers instead of paying a
+        # layout change mid-program. A 1-device mesh is the degenerate
+        # case of the same call.
+        self.state = jax.device_put(self.state, self.state_shardings)
         # Anchor-KL regularizer (PPOConfig.anchor_kl_coef): the anchor is
         # the policy AS CONSTRUCTED — after --init-from/--restore — i.e.
         # the transferred policy in a curriculum fine-tune. Copied: the
@@ -893,8 +932,14 @@ class Learner:
         # rewind (the retraining re-earns them); step and version diverge
         # from here on, which nothing downstream assumes away.
         resumed_version = from_version + 1
-        self.state = dataclasses.replace(
-            state, version=jnp.asarray(resumed_version, jnp.int32)
+        # re-commit to the mesh (restores return host-layout arrays; the
+        # next donated step expects its state_shardings — same re-shard
+        # the constructor applies)
+        self.state = jax.device_put(
+            dataclasses.replace(
+                state, version=jnp.asarray(resumed_version, jnp.int32)
+            ),
+            self.state_shardings,
         )
         self._host_step = int(np.asarray(state.step))      # host-sync-ok: rollback cadence
         self._host_version = resumed_version
@@ -1674,6 +1719,16 @@ def main(argv=None) -> Dict[str, float]:
         "'async_snapshots=false' (the long form of --sync-snapshots)",
     )
     p.add_argument(
+        "--mesh", type=str, default=None, metavar="K=V,...",
+        help="comma-separated MeshConfig overrides (device-mesh layout, "
+        "ISSUE 10), e.g. 'data_parallel=4,model_parallel=2' or "
+        "'dcn_slices=2'; data_parallel=-1 (default) takes every remaining "
+        "device. --model-parallel/--dcn-slices are shorthands for the "
+        "same fields; an explicit layout smaller than the visible device "
+        "set uses the first dcn×data×model devices (a 1-device mesh is "
+        "the degenerate case of the one sharded code path)",
+    )
+    p.add_argument(
         "--sync-snapshots", action="store_true",
         help="debug opt-out of the async snapshot engine (ISSUE 5): run "
         "the weights publish, periodic checkpoints, and log-boundary "
@@ -1834,6 +1889,7 @@ def main(argv=None) -> Dict[str, float]:
         HealthConfig,
         LeagueConfig,
         LearnerConfig,
+        MeshConfig,
         PPOConfig,
         RewardConfig,
     )
@@ -1849,6 +1905,9 @@ def main(argv=None) -> Dict[str, float]:
         ("--buffer", args.buffer, "buffer", BufferConfig),
         ("--health", args.health, "health", HealthConfig),
         ("--learner", args.learner, "learner", LearnerConfig),
+        # --mesh composes with the --dcn-slices/--model-parallel
+        # shorthands (applied above); explicit --mesh keys win
+        ("--mesh", args.mesh, "mesh", MeshConfig),
     ):
         if not text:
             continue

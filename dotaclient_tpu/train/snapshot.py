@@ -264,7 +264,14 @@ class SnapshotEngine:
                     self._cond.notify_all()
 
     def _fetch(self, tree: Any) -> Any:
-        """The ONE batched device→host transfer per job."""
+        """The ONE batched device→host transfer per job.
+
+        Mesh-sharded snapshots (ISSUE 10) need no special casing here:
+        the submitted copies keep the live state's shardings, and
+        ``device_get`` assembles replicated leaves from shard 0 (and
+        gathers TP-partitioned ones) — ON THIS THREAD, so the train
+        thread's boundary stays dispatch-only at every device count
+        (pinned by tests/test_multichip.py's zero-fetch test)."""
         t0 = time.perf_counter()
         host = jax.device_get(tree)  # host-sync-ok: snapshot thread — the transfer this engine exists to absorb
         self._tel.gauge("snapshot/d2h_ms").set(

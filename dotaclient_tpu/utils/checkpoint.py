@@ -21,6 +21,14 @@ Integrity + retention (ISSUE 6, the guardian's *recover* stage):
   guardian verified — outside the main rolling GC, so divergence rollback
   (train/learner.py) always has a healthy restore point even after the
   main retention loop has moved on.
+
+Sharded-state contract (ISSUE 10): saves always write HOST-LAYOUT arrays
+— ``jax.device_get`` assembles mesh-sharded leaves (replicated params
+read from shard 0; TP-partitioned leaves gather) — so checkpoints are
+device-count-free. Restores symmetrically return host-layout/uncommitted
+arrays; the CALLER re-commits to its current mesh (the learner's
+``state_shardings`` device_put), which is what makes an 8-chip checkpoint
+restore into a 1-chip run and vice versa (tests/test_multichip.py).
 """
 
 from __future__ import annotations

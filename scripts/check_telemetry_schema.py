@@ -135,6 +135,20 @@ HEALTH_KEYS = (
     "buffer/stale_rejected_total",      # admission-control staleness drops
 )
 
+# Multi-chip learner (ISSUE 10). Validated with --require-multichip
+# against ANY learner run's JSONL: the Learner eager-creates all four at
+# construction (mesh geometry + the one-time startup all-reduce probe;
+# buffer/shard_bytes stays 0 for bufferless fused runs and carries the
+# per-device resident ring bytes otherwise), so presence is deterministic
+# at every device count — a 1-device mesh is the degenerate case of the
+# same code path.
+MULTICHIP_KEYS = (
+    "mesh/n_devices",        # devices in the learner's mesh
+    "mesh/data_shards",      # batch shard count (dcn × data axes)
+    "buffer/shard_bytes",    # per-device resident bytes of the HBM ring
+    "learner/psum_ms",       # startup probe: one mesh all-reduce round trip
+)
+
 # Keys only an IN-PROCESS actor emits. A learner serving external actor
 # processes over socket/shm never runs its own collect loop, so its JSONL
 # legitimately lacks these — they are waived when the line union carries an
@@ -256,6 +270,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         "valid against any learner run with health.enabled (the default) — "
         "the HealthMonitor eager-creates them in both snapshot modes",
     )
+    p.add_argument(
+        "--require-multichip", action="store_true",
+        help="also require the multi-chip learner keys (ISSUE 10); valid "
+        "against ANY learner run's JSONL at any device count — the "
+        "Learner eager-creates mesh geometry, the startup all-reduce "
+        "probe, and the ring's per-shard byte gauge at construction",
+    )
     args = p.parse_args(argv)
     extra: tuple = ()
     if args.require_transport:
@@ -270,6 +291,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         extra += WIRE_KEYS
     if args.require_health:
         extra += HEALTH_KEYS
+    if args.require_multichip:
+        extra += MULTICHIP_KEYS
 
     path = args.path
     if path is None:
