@@ -579,6 +579,127 @@ def bench_multichip(config) -> dict:
     }
 
 
+def bench_serve(config) -> dict:
+    """Serve stage (ISSUE 11): the continuous-batching policy server's
+    headline curve — actions/sec and p99 request latency vs batch window —
+    plus the parity digest.
+
+    * **curve** — for each ``serve.batch_window_ms`` setting, a real
+      ``PolicyServer`` (socket lane, CRC framing) serves a synthetic fleet
+      (``scripts/serve_loadgen.py``: N threads × R sequential requests,
+      one carry slot each). Larger windows coalesce more requests per
+      dispatch (higher ``serve/batch_fill``, better actions/sec) at the
+      cost of per-request deadline latency — the trade the knob exists to
+      tune. Best-of-2 trials per window (the usual best-of rule on this
+      noise-prone host). The headline pair is taken from the
+      best-throughput window.
+    * **parity digest** — a max_batch=1/window=0 server replays a
+      deterministic request stream; every wire reply must equal, bitwise,
+      the action the engine's own compiled dispatch produces in-process
+      for the same obs, carry-slot state, and rng stream
+      (``fold_in(key(serve.seed), dispatch_idx)``) — the transport and
+      batching machinery must be invisible to the policy. Pass/fail.
+    """
+    import dataclasses
+
+    from dotaclient_tpu.models import init_params, make_policy
+    from dotaclient_tpu.serve import (
+        PolicyServer,
+        ServeClient,
+        ServeEngine,
+        make_inference_policy,
+        slice_train_params,
+    )
+    from scripts.serve_loadgen import run_loadgen, synthetic_obs
+
+    full = make_policy(config.model, config.obs, config.actions)
+    params = slice_train_params(init_params(full, jax.random.PRNGKey(0)))
+
+    windows_ms = (0.5, 4.0)
+    n_clients, n_requests = 16, 40
+    out: dict = {"windows": {}}
+    best = (0.0, None)
+    for window in windows_ms:
+        cfg = dataclasses.replace(
+            config,
+            serve=dataclasses.replace(
+                config.serve, batch_window_ms=window,
+                max_batch=n_clients, max_slots=2 * n_clients,
+            ),
+        )
+        engine = ServeEngine(cfg, make_inference_policy(cfg), params)
+        server = PolicyServer(engine, cfg, port=0)
+        host, port = server.address
+        try:
+            # warmup: compile the dispatch + settle the lanes
+            run_loadgen(host, port, cfg, n_clients=4, requests_per_client=4)
+            result = {"actions_per_sec": 0.0, "p99_ms": 0.0}
+            for _ in range(2):
+                trial = run_loadgen(
+                    host, port, cfg,
+                    n_clients=n_clients, requests_per_client=n_requests,
+                )
+                if trial["actions_per_sec"] > result["actions_per_sec"]:
+                    result = trial
+            out["windows"][f"{window}ms"] = {
+                "actions_per_sec": result["actions_per_sec"],
+                "p50_ms": result.get("p50_ms", 0.0),
+                "p99_ms": result.get("p99_ms", 0.0),
+                "replies": result.get("replies", 0),
+                "errors": result.get("errors", 0),
+            }
+            if result["actions_per_sec"] > best[0]:
+                best = (result["actions_per_sec"], f"{window}ms")
+        finally:
+            server.close()
+            engine.stop()
+    headline = out["windows"].get(best[1], {"actions_per_sec": 0.0, "p99_ms": 0.0})
+    out["actions_per_sec"] = headline["actions_per_sec"]
+    out["p99_ms"] = headline["p99_ms"]
+    out["best_window"] = best[1]
+
+    # -- parity digest: served replies == in-process dispatch, bitwise ------
+    cfg = dataclasses.replace(
+        config,
+        serve=dataclasses.replace(
+            config.serve, batch_window_ms=0.0, max_batch=1, max_slots=4
+        ),
+    )
+    policy = make_inference_policy(cfg)
+    engine = ServeEngine(cfg, policy, params)
+    server = PolicyServer(engine, cfg, port=0)
+    host, port = server.address
+    n_parity = 8
+    try:
+        rng = np.random.default_rng(123)
+        stream = [synthetic_obs(cfg, rng) for _ in range(n_parity)]
+        client = ServeClient(host, port, cfg)
+        served = []
+        for i, obs in enumerate(stream):
+            client.step(obs, reset=(i == 0))
+            served.append(client.last_packed.copy())
+        client.close()
+        # in-process replay: same compiled function, same slot/reset/rng
+        # stream, its own carry tree (slot 0, as the attach assigned)
+        carries = jax.tree.map(
+            jax.numpy.asarray, policy.initial_state(cfg.serve.max_slots + 1)
+        )
+        mismatches = 0
+        for i, obs in enumerate(stream):
+            packed, _, carries = engine.reference_step(
+                [obs], [client.slot], [1.0 if i == 0 else 0.0], carries, i
+            )
+            if not np.array_equal(packed[0], served[i]):
+                mismatches += 1
+        out["parity_requests"] = n_parity
+        out["parity_mismatches"] = mismatches
+        out["parity"] = 1.0 if mismatches == 0 else 0.0
+    finally:
+        server.close()
+        engine.stop()
+    return out
+
+
 def main() -> None:
     from dotaclient_tpu.config import default_config
     from dotaclient_tpu.models import init_params, make_policy
@@ -781,6 +902,18 @@ def main() -> None:
     except Exception as e:
         multichip = {"error": f"{type(e).__name__}: {e}"}
 
+    # -- serve stage: continuous-batching policy server (ISSUE 11) -----------
+    try:
+        serve = bench_serve(config)
+        # acceptance: serve_parity == 1.0 (wire replies bitwise-equal the
+        # in-process dispatch); the actions/sec + p99 pair is the headline
+        # serving curve at the best-throughput batch window
+        stages["serve_actions_per_sec"] = serve.get("actions_per_sec", 0.0)
+        stages["serve_p99_ms"] = serve.get("p99_ms", 0.0)
+        stages["serve_parity"] = serve.get("parity", 0.0)
+    except Exception as e:
+        serve = {"error": f"{type(e).__name__}: {e}"}
+
     anchor = None
     if os.path.exists(ANCHOR_PATH):
         try:
@@ -817,6 +950,7 @@ def main() -> None:
                 "health": health,
                 "quantize": quantize,
                 "multichip": multichip,
+                "serve": serve,
                 "telemetry_jsonl": telemetry_path,
             }
         )

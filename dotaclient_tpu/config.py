@@ -378,6 +378,44 @@ class HealthConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Low-latency policy-serving plane (ISSUE 11; dotaclient_tpu/serve).
+
+    The serving workload is training inverted: many concurrent games each
+    wanting ONE action at tight latency. The continuous-batching engine
+    collects per-game step requests into preallocated staging lanes until
+    ``batch_window_ms`` elapses or ``max_batch`` requests are staged
+    (whichever first), runs ONE jitted dispatch over the padded batch with
+    server-resident recurrent carries, and scatters sampled actions back
+    per requester. These knobs trade latency (smaller window) against
+    throughput (fuller batches) — ``bench.py``'s serve stage measures the
+    curve."""
+
+    # Batch-collection deadline in milliseconds. 0 dispatches whatever is
+    # pending immediately (minimum latency, worst batching).
+    batch_window_ms: float = 2.0
+    # Requests per dispatch (the padded batch's static shape — changing it
+    # recompiles the serve step). A window closes early when it fills.
+    max_batch: int = 64
+    # Server-resident carry slots = concurrently attached games. A slot is
+    # allocated at client attach, zeroed on release, and reused; clients
+    # never ship recurrent state.
+    max_slots: int = 256
+    # Request wire dtype ("float32" | "bfloat16"): bf16 narrows the
+    # request's observation leaves via the rollout cast-plan machinery
+    # (ISSUE 7) — the same ``__wire_cast__`` marker discipline, roughly
+    # half the request bytes. Replies (a few ints + one float) stay f32.
+    request_wire_dtype: str = "float32"
+    # Weight-swap subscription cadence: the serve server's weights thread
+    # polls its fanout subscription (socket or shm lane) this often; a new
+    # version hot-swaps BETWEEN dispatches, never within one.
+    weights_poll_s: float = 0.5
+    # Base seed of the serve-side sampling RNG stream: dispatch i samples
+    # with fold_in(key(seed), i) — the stream the parity digest replays.
+    seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
 class LeagueConfig:
     enabled: bool = False
     pool_size: int = 8
@@ -426,6 +464,7 @@ class RunConfig:
     transport: TransportConfig = TransportConfig()
     learner: LearnerConfig = LearnerConfig()
     health: HealthConfig = HealthConfig()
+    serve: ServeConfig = ServeConfig()
     league: LeagueConfig = LeagueConfig()
     checkpoint_dir: str = "checkpoints"
     checkpoint_every: int = 100
@@ -473,6 +512,8 @@ class RunConfig:
             learner=LearnerConfig(**raw.get("learner", {})),
             # .get: absent in checkpoints written before HealthConfig
             health=HealthConfig(**raw.get("health", {})),
+            # .get: absent in checkpoints written before ServeConfig
+            serve=ServeConfig(**raw.get("serve", {})),
             league=LeagueConfig(**raw["league"]),
             # .get: absent in checkpoints written before the field existed
             checkpoint_best_min_episodes=raw.get(

@@ -5,7 +5,7 @@ snapshots, mixed self-play vs frozen-past sampling, and the win-rate eval
 harness the headline metric is measured with.
 """
 
-from dotaclient_tpu.league.evaluation import evaluate
+from dotaclient_tpu.league.evaluation import evaluate, evaluate_served
 from dotaclient_tpu.league.pool import OpponentPool, Snapshot
 
-__all__ = ["OpponentPool", "Snapshot", "evaluate"]
+__all__ = ["OpponentPool", "Snapshot", "evaluate", "evaluate_served"]

@@ -6,12 +6,21 @@ bot*; the reference measured it by watching TensorBoard against live games
 evaluation games on the on-device sim — no training, no experience shipping
 — and report the result. Also used league-side to check whether the current
 policy beats its own frozen past (SURVEY.md §7 step 7).
+
+Both eval modes run the **inference-only policy path** (ISSUE 11,
+dotaclient_tpu/serve): the same trunk/core/head modules with the value head
+sliced out of the param tree — eval discards values, so results are
+bit-identical to the training-shaped policy (pinned by
+tests/test_serve.py) and the eval actor never materializes critic params.
+``evaluate`` plays on the fused on-device rollout loop; ``evaluate_served``
+plays the SAME games through a live serve server — the serving plane's
+first real client and its end-to-end correctness probe.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
@@ -37,8 +46,15 @@ def evaluate(
     ``opponent_params`` (frozen policy). Returns win_rate / episodes /
     mean episode return. Games run on the on-device rollout loop; this
     function is the only host sync.
+
+    ``policy`` may be either the training-shaped module or an
+    inference-only one — the eval actor always runs the inference-only
+    path: the value head (which eval discards) is sliced out of ``params``
+    and a ``value_head=False`` module applies the slim tree. Sampling is
+    untouched, so results are bit-identical either way.
     """
     from dotaclient_tpu.actor.device_rollout import DeviceActor
+    from dotaclient_tpu.serve.policy_path import slice_train_params
 
     tel = telemetry.get_registry()
     eval_cfg = dataclasses.replace(
@@ -57,8 +73,20 @@ def evaluate(
     )
     # the eval actor records into a PRIVATE registry: its frames/collect
     # latencies (different config, different cadence) must not contaminate
-    # the training pipeline's counters and EMAs in the global registry
-    actor = DeviceActor(eval_cfg, policy, seed=seed, registry=telemetry.Registry())
+    # the training pipeline's counters and EMAs in the global registry.
+    # Inference-only path (ISSUE 11): the CALLER's module (its
+    # architecture is authoritative — a checkpoint's config may diverge
+    # from `config`) cloned without the value head, over sliced trees —
+    # no critic params ride into the eval program.
+    slim_policy = (
+        policy if not policy.value_head else policy.clone(value_head=False)
+    )
+    actor = DeviceActor(
+        eval_cfg, slim_policy, seed=seed, registry=telemetry.Registry()
+    )
+    params = slice_train_params(params)
+    if opponent_params is not None:
+        opponent_params = slice_train_params(opponent_params)
     steps_per_episode = eval_cfg.env.max_dota_time / (
         eval_cfg.env.ticks_per_observation / 30.0
     )
@@ -84,4 +112,161 @@ def evaluate(
         "win_rate": stats["win_rate"],
         "episodes": stats["episodes_done"],
         "episode_reward_mean": stats["episode_reward_mean"],
+    }
+
+
+def evaluate_served(
+    config: RunConfig,
+    address: Tuple[str, int],
+    opponent: str = "scripted_hard",
+    n_games: int = 8,
+    seed: int = 0,
+    max_steps: Optional[int] = None,
+) -> Dict[str, float]:
+    """Play ``n_games`` full games THROUGH a live serve server (ISSUE 11).
+
+    The serving plane's first client: games run on the host scalar sim
+    (the gRPC-parity env), and every action comes back over the
+    request/reply wire — one :class:`serve.ServeClient` (one carry slot)
+    per agent-controlled hero, ``reset=True`` on each episode's first
+    step. Games run concurrently, so the server's continuous batching has
+    real work to coalesce. Same result surface as :func:`evaluate`.
+    """
+    from dotaclient_tpu.actor.runtime import build_game_config
+    from dotaclient_tpu.envs.env_api import LocalDotaEnv
+    from dotaclient_tpu.features import (
+        decode_action,
+        featurize,
+        observation_to_dict,
+        shaped_reward,
+    )
+    from dotaclient_tpu.protos import dota_pb2 as pb
+    from dotaclient_tpu.serve.client import ServeClient
+
+    host, port = address
+    eval_cfg = dataclasses.replace(
+        config, env=dataclasses.replace(config.env, opponent=opponent)
+    )
+    tel = telemetry.get_registry()
+    steps_per_episode = eval_cfg.env.max_dota_time / (
+        eval_cfg.env.ticks_per_observation / 30.0
+    )
+    max_steps = max_steps or int(2 * steps_per_episode * n_games + 16)
+    next_seed = seed
+
+    class _Game:
+        def __init__(self) -> None:
+            nonlocal next_seed
+            self.env = LocalDotaEnv()
+            self.game_cfg = build_game_config(eval_cfg, next_seed)
+            next_seed += 1
+            self.lanes = []  # (client, player_id, team_id) per agent hero
+            self.pending_actions: Dict[int, list] = {}
+            self.reset()
+
+        def reset(self) -> None:
+            nonlocal next_seed
+            init = self.env.reset(self.game_cfg)
+            ws_by_team = {ws.team_id: ws for ws in init.world_states}
+            agent_players = [
+                (pid, pick.team_id)
+                for pid, pick in enumerate(self.game_cfg.hero_picks)
+                if pick.control_mode == pb.CONTROL_AGENT
+            ]
+            if not self.lanes:
+                self.lanes = [
+                    {"client": ServeClient(host, port, config)}
+                    for _ in agent_players
+                ]
+            for lane, (player_id, team_id) in zip(self.lanes, agent_players):
+                lane.update(
+                    player_id=player_id, team_id=team_id,
+                    ws=ws_by_team[team_id], reset=True,
+                )
+            self.episode_reward = 0.0
+            # the next episode on this env gets a fresh draw
+            self.game_cfg = build_game_config(eval_cfg, next_seed)
+            next_seed += 1
+
+        def close(self) -> None:
+            for lane in self.lanes:
+                lane["client"].close()
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    n_concurrent = max(1, min(n_games, 16))
+    games = [_Game() for _ in range(n_concurrent)]
+    all_lanes = [(game, lane) for game in games for lane in game.lanes]
+    episodes = wins = 0
+    episode_rewards = []
+
+    def request_action(pair):
+        """One lane's featurize + wire round trip — runs on the pool so
+        every concurrent game's request is in flight AT ONCE and the
+        server's batch window has real work to coalesce (a serial client
+        loop would hand the batcher one lonely request per deadline)."""
+        game, lane = pair
+        obs = featurize(
+            lane["ws"], lane["player_id"], eval_cfg.obs, eval_cfg.actions
+        )
+        idx = lane["client"].step(
+            observation_to_dict(obs), reset=lane["reset"]
+        )
+        lane["reset"] = False
+        lane["obs"] = obs
+        return idx
+
+    try:
+        with tel.span("league/evaluate"), ThreadPoolExecutor(
+            max_workers=len(all_lanes)
+        ) as pool:
+            for _ in range(max_steps):
+                if episodes >= n_games:
+                    break
+                actions = list(pool.map(request_action, all_lanes))
+                for (game, lane), idx in zip(all_lanes, actions):
+                    by_team = game.pending_actions
+                    by_team.setdefault(lane["team_id"], []).append(
+                        decode_action(
+                            idx, lane["obs"], lane["player_id"],
+                            move_bins=eval_cfg.actions.move_bins,
+                        )
+                    )
+                for game in games:
+                    for team_id, protos in game.pending_actions.items():
+                        game.env.act(
+                            pb.Actions(team_id=team_id, actions=protos)
+                        )
+                    game.pending_actions = {}
+                    owner = game.lanes[0]
+                    done = False
+                    for lane in game.lanes:
+                        resp = game.env.observe(lane["team_id"])
+                        ws = resp.world_state
+                        if lane is owner:
+                            r, _ = shaped_reward(
+                                lane["ws"], ws, lane["player_id"],
+                                weights=eval_cfg.reward.as_dict(),
+                            )
+                            game.episode_reward += r
+                        lane["ws"] = ws
+                        done = done or game.env.done
+                    if done:
+                        episodes += 1
+                        if owner["ws"].winning_team == owner["team_id"]:
+                            wins += 1
+                        episode_rewards.append(game.episode_reward)
+                        game.reset()
+    finally:
+        for game in games:
+            game.close()
+    win_rate = wins / episodes if episodes else 0.0
+    reward_mean = float(np.mean(episode_rewards)) if episode_rewards else 0.0
+    tel.gauge("league/eval_win_rate").set(win_rate)
+    tel.gauge("league/eval_episodes").set(float(episodes))
+    tel.gauge("league/eval_reward_mean").set(reward_mean)
+    return {
+        "win_rate": win_rate,
+        "episodes": float(episodes),
+        "episode_reward_mean": reward_mean,
     }

@@ -42,6 +42,7 @@ OVERRIDE_FLAGS: Dict[str, str] = {
     "--health": "HealthConfig",
     "--learner": "LearnerConfig",
     "--mesh": "MeshConfig",
+    "--serve": "ServeConfig",
 }
 
 # CLIs whose full flag surface must be documented in OPERATIONS.md
@@ -54,6 +55,8 @@ OPERATOR_CLIS = (
 ALL_CLIS = OPERATOR_CLIS + (
     "dotaclient_tpu/league/__main__.py",
     "dotaclient_tpu/lint/__main__.py",
+    "dotaclient_tpu/serve/__main__.py",
+    "scripts/serve_loadgen.py",
     "scripts/chaos_run.py",
     "scripts/run_multichip.py",
     "scripts/train_demo.py",

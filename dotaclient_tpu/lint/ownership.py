@@ -163,6 +163,70 @@ OWNERSHIP: Dict[str, Dict[str, ClassMap]] = {
             },
         ),
     },
+    "dotaclient_tpu/serve/engine.py": {
+        # Serving plane (ISSUE 11): reader threads submit, ONE batcher
+        # thread owns every carry/staging/params mutation (weight swaps
+        # and slot zeroes are marshalled to it through latest-wins/pending
+        # sets), the weight-swap thread only parks host trees. The PR 5-6
+        # race shapes are exactly what this map machine-checks from day
+        # one: a reader touching the carry store, a swap landing
+        # mid-dispatch, a reply raced past its connection's death.
+        "ServeEngine": ClassMap(
+            default_thread="client",   # submit/release/stop: caller side
+            methods={
+                "_batch_loop": "batcher",
+                "_apply_pending_weights": "batcher",
+                "_collect_window": "batcher",
+                "_dispatch_window": "batcher",
+                # the parity probe replays the batcher's compiled dispatch
+                # on the batcher's data — valid only with the server
+                # quiesced, so it is held to the batcher's discipline
+                "reference_step": "batcher",
+            },
+            attrs={
+                "_pending": "lock:_cond",
+                "_reset_slots": "lock:_cond",
+                "_stopped": "lock:_cond",
+                "_pending_weights": "lock:_weights_lock",
+                # THE carry-residency hazard: dispatches donate the store's
+                # buffers, so only the batcher — which ordered those
+                # dispatches — may ever touch it (slot zeroes marshal
+                # through _reset_slots, never direct writes).
+                "_carries": "batcher",
+                "_params": "batcher",
+                "_lanes": "batcher",
+                "_slots_np": "batcher",
+                "_reset_np": "batcher",
+                "_dispatch_idx": "batcher",
+                # latched int: written by the batcher at swap commit, read
+                # by attach frames — one-dispatch-stale reads are the design
+                "_version": "any",
+            },
+        ),
+    },
+    "dotaclient_tpu/serve/server.py": {
+        "PolicyServer": ClassMap(
+            default_thread="learner",   # construct/attach/close: owner side
+            methods={
+                "_accept_loop": "accept",
+                "_reader_loop": "reader",
+                "_poison": "reader",
+                "_make_reply": "reader",
+                "_writer_loop": "writer",
+                # the weights-subscription poller (attach_weights_source)
+                "loop": "weights",
+                # torn down from readers, writers, and close alike; touches
+                # only lock-guarded state and the conn's own cond
+                "_drop": "any",
+                "_publish_conn_gauges": "any",
+            },
+            attrs={
+                "_conns": "lock:_conns_lock",
+                "_free_slots": "lock:_conns_lock",
+                "_weights_thread": "learner",
+            },
+        ),
+    },
     "dotaclient_tpu/transport/shm_transport.py": {
         # Single-consumer by design: every method runs on the learner
         # thread (no background threads in the shm server — liveness is

@@ -75,7 +75,8 @@ _DOC_KEY_RE = re.compile(
 # namespace must be added here when its first key is minted.
 KEY_PREFIXES = (
     "actor/", "buffer/", "checkpoint/", "faults/", "health/", "league/",
-    "learner/", "mesh/", "shm/", "snapshot/", "span/", "transport/",
+    "learner/", "mesh/", "serve/", "shm/", "snapshot/", "span/",
+    "transport/",
 )
 # single-line inline code only: multi-line matches would mispair across
 # ``` fence lines (odd backtick count flips pairing for the whole doc)

@@ -68,11 +68,21 @@ class UnitEncoder(nn.Module):
 
 
 class Policy(nn.Module):
-    """Actor-critic policy with a recurrent core."""
+    """Actor-critic policy with a recurrent core.
+
+    ``value_head=False`` is the inference-only path (ISSUE 11, the serving
+    plane): the SAME trunk/core/head modules — so logits are bit-identical
+    by construction — but no value head is ever created, and the param tree
+    is exactly the training tree minus ``head_value``
+    (``serve.policy_path.slice_train_params`` produces it from a training
+    checkpoint or a published weights frame). The step/sequence signatures
+    are unchanged; the value output is a constant-zero placeholder so every
+    actor-side consumer (which discards it) works with either variant."""
 
     model: ModelConfig
     obs_spec: ObsSpec
     action_spec: ActionSpec
+    value_head: bool = True
 
     def setup(self):
         cfg = self.model
@@ -107,7 +117,8 @@ class Policy(nn.Module):
         self.head_ability = nn.Dense(hs["ability"], dtype=dtype, param_dtype=pdtype)
         # Target-unit head: dot-product attention query over unit embeddings.
         self.target_query = nn.Dense(self.model.unit_embed_dim, dtype=dtype, param_dtype=pdtype)
-        self.head_value = nn.Dense(1, dtype=jnp.float32, param_dtype=pdtype)
+        if self.value_head:
+            self.head_value = nn.Dense(1, dtype=jnp.float32, param_dtype=pdtype)
 
     # -- shared trunk ------------------------------------------------------
 
@@ -147,7 +158,10 @@ class Policy(nn.Module):
             "target_unit": target_logits,
             "ability": self.head_ability(y).astype(jnp.float32),
         }
-        value = self.head_value(y.astype(jnp.float32))[..., 0]
+        if self.value_head:
+            value = self.head_value(y.astype(jnp.float32))[..., 0]
+        else:
+            value = jnp.zeros(y.shape[:-1], jnp.float32)
         return logits, value
 
     # -- public modes ------------------------------------------------------

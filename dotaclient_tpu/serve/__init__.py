@@ -1,0 +1,31 @@
+"""Low-latency policy-serving plane (ISSUE 11).
+
+Everything before this package feeds training; this is the inverse
+workload — many concurrent games each wanting ONE action at tight latency.
+``ServeEngine`` is the continuous-batching core (staged request windows,
+one jitted dispatch, server-resident carries), ``PolicyServer``/
+``ServeClient`` put it on the shared CRC wire lane, and ``policy_path``
+builds the inference-only param tree from training checkpoints or
+published weights frames. See docs/ARCHITECTURE.md "Policy serving plane".
+"""
+
+from dotaclient_tpu.serve.client import ServeClient, serve_request_wire_kwargs
+from dotaclient_tpu.serve.engine import ServeEngine
+from dotaclient_tpu.serve.policy_path import (
+    load_inference_params,
+    make_inference_policy,
+    slice_train_params,
+    weights_frame_to_params,
+)
+from dotaclient_tpu.serve.server import PolicyServer
+
+__all__ = [
+    "PolicyServer",
+    "ServeClient",
+    "ServeEngine",
+    "load_inference_params",
+    "make_inference_policy",
+    "serve_request_wire_kwargs",
+    "slice_train_params",
+    "weights_frame_to_params",
+]

@@ -1,0 +1,144 @@
+"""Serve-plane client: one connection, one carry slot, one game.
+
+The protocol is intentionally dumb — a game wants exactly one action per
+observation, so the client is synchronous: ``step(obs)`` ships one request
+frame and blocks until the echoing reply arrives. Recurrent state never
+crosses the wire: the server keeps this game's carry in the slot it
+assigned at attach (the first frame on the connection names it), and
+``reset=True`` on the first step of each episode zeroes that slot before
+the core — the same episode-boundary discipline the actors apply.
+
+Request payloads ride the rollout codec, so
+``serve.request_wire_dtype="bfloat16"`` narrows observation leaves through
+the ISSUE 7 cast-plan machinery (``__wire_cast__`` marker, config-bounded
+exact int casts); CRC trailers and the quarantine discipline come with the
+shared framing. Corrupt inbound replies raise — the client is disposable
+(its slot reclaims server-side) and whoever owns the game reconnects.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from dotaclient_tpu.config import RunConfig
+from dotaclient_tpu.models.distributions import HEADS
+from dotaclient_tpu.serve.server import (
+    ATTACH_REQUEST_ID,
+    KIND_SERVE_REPLY,
+    KIND_SERVE_REQUEST,
+)
+from dotaclient_tpu.transport.socket_transport import (
+    _recv_frame,
+    _send_frame,
+)
+from dotaclient_tpu.transport.serialize import (
+    decode_rollout_bytes,
+    encode_rollout_bytes,
+    rollout_int_bounds,
+)
+
+
+def serve_request_wire_kwargs(config: RunConfig) -> Dict[str, Any]:
+    """Encode kwargs for the request wire — ``{}`` for full width, the
+    rollout cast plan (bf16 floats, exact bounded ints) otherwise. The one
+    derivation every request encoder shares (client, loadgen, tests)."""
+    if config.serve.request_wire_dtype == "float32":
+        return {}
+    return dict(
+        wire_dtype=config.serve.request_wire_dtype,
+        int_bounds=rollout_int_bounds(config),
+    )
+
+
+class ServeClient:
+    """Blocking request/reply client for one game."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        config: RunConfig,
+        timeout_s: float = 30.0,
+    ) -> None:
+        self._sock = socket.create_connection((host, port), timeout=timeout_s)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(timeout_s)
+        self._wire_kwargs = serve_request_wire_kwargs(config)
+        self._next_id = 1   # 0 is the attach frame's id
+        self.last_version = 0
+        self.last_logp = 0.0
+        self.last_latency_s = 0.0
+        self._last_packed = np.zeros((len(HEADS),), np.int32)
+        # attach: the first frame names this connection's carry slot and
+        # the server's current weights version. A shed joiner (every slot
+        # taken → the server closes without an attach frame) must not
+        # leak the fd — attach-retry loops would bleed sockets.
+        try:
+            meta = self._recv_reply(ATTACH_REQUEST_ID)[0]
+        except BaseException:
+            self.close()
+            raise
+        self.slot = meta["env_id"]
+        self.last_version = meta["model_version"]
+
+    def _recv_reply(self, request_id: int) -> Tuple[Dict[str, Any], Any]:
+        while True:
+            frame = _recv_frame(self._sock)
+            if frame is None:
+                raise ConnectionError("serve server closed the connection")
+            kind, payload = frame
+            if kind != KIND_SERVE_REPLY:
+                continue   # future control kinds: skip, stay in sync
+            meta, arrays = decode_rollout_bytes(payload, upcast=True)
+            if meta["rollout_id"] == request_id:
+                return meta, arrays
+            # an out-of-order echo (attach duplicates): keep draining
+
+    def step(
+        self,
+        obs: Dict[str, np.ndarray],
+        reset: bool = False,
+    ) -> Dict[str, int]:
+        """One action for one observation (unbatched leaves). Returns the
+        per-head action indices; the joint log-prob, serving weights
+        version, raw packed row, and measured round-trip latency land on
+        ``last_logp`` / ``last_version`` / ``last_packed`` /
+        ``last_latency_s``."""
+        request_id = self._next_id
+        self._next_id += 1
+        payload = encode_rollout_bytes(
+            {
+                "obs": obs,
+                "reset": np.asarray(1.0 if reset else 0.0, np.float32),
+            },
+            model_version=self.last_version,
+            env_id=self.slot,
+            rollout_id=request_id,
+            length=1,
+            total_reward=0.0,
+            **self._wire_kwargs,
+        )
+        t0 = time.perf_counter()
+        _send_frame(self._sock, KIND_SERVE_REQUEST, payload)
+        meta, arrays = self._recv_reply(request_id)
+        self.last_latency_s = time.perf_counter() - t0
+        self.last_version = meta["model_version"]
+        self._last_packed = np.asarray(arrays["actions"]).astype(np.int32)
+        self.last_logp = float(np.asarray(arrays["logp"]).reshape(-1)[0])
+        return {h: int(self._last_packed[j]) for j, h in enumerate(HEADS)}
+
+    @property
+    def last_packed(self) -> np.ndarray:
+        """The raw packed ``[5]`` int32 action row of the last reply (the
+        parity digest compares these bitwise)."""
+        return self._last_packed
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,348 @@
+"""Socket front door of the serving plane: request/reply on the wire lane.
+
+Requests and replies ride the SAME wire discipline as the training
+transports (transport/socket_transport.py): length-prefixed frames with a
+header CRC (framing loss is fatal — TCP cannot resync) and a payload CRC32
+trailer, corrupt frames dropped and counted
+(``transport/frames_corrupt_total``), and a peer that ships
+``transport.poison_frame_limit`` CONSECUTIVE bad frames quarantined
+(``transport/peers_quarantined``) — its connection cut and its carry slot
+reclaimed. Two new frame kinds extend the shared kind space:
+``KIND_SERVE_REQUEST`` (3) and ``KIND_SERVE_REPLY`` (4).
+
+Payloads reuse the rollout codec end-to-end: a request is
+``encode_rollout_bytes({"obs": ..., "reset": ...})`` — so
+``serve.request_wire_dtype="bfloat16"`` narrows observation leaves through
+the exact ``__wire_cast__`` cast-plan machinery of ISSUE 7 — with
+``env_id`` carrying the client's slot and ``rollout_id`` the request id the
+reply echoes. A reply carries the packed per-head actions, the joint
+log-prob, and the weights version that sampled it (``model_version``).
+
+Slot lifecycle: the server allocates the lowest free carry slot at accept
+and sends an attach frame (a reply-kind frame whose ``env_id`` names the
+slot) through the connection's writer; disconnect, idle timeout, and
+quarantine all release the slot — the engine zeroes its carry row between
+dispatches, so the next game to claim it starts fresh even if its client
+forgets the first-step ``reset`` flag.
+
+Weight refresh: ``attach_weights_source`` subscribes the server to a
+weights fanout — any object with the transports' ``latest_weights()``
+surface, i.e. a ``SocketTransport`` connected to the learner's socket
+fanout or a ``ShmTransport`` attached to the same-host shm slab — and a
+dedicated thread polls it, slices each new frame into the inference-only
+tree, and submits it to the engine (hot-swapped between dispatches,
+monotonic version).
+"""
+
+from __future__ import annotations
+
+import heapq
+import socket
+import threading
+from collections import deque
+from typing import Any, Deque, List, Optional, Tuple
+
+import numpy as np
+
+from dotaclient_tpu.models.distributions import HEADS
+from dotaclient_tpu.serve.engine import ServeEngine
+from dotaclient_tpu.serve.policy_path import weights_frame_to_params
+from dotaclient_tpu.transport.socket_transport import (
+    FrameCorrupt,
+    FramingLost,
+    _recv_frame,
+    _send_frame,
+)
+from dotaclient_tpu.transport.serialize import (
+    decode_rollout_bytes,
+    encode_rollout_bytes,
+)
+from dotaclient_tpu.utils import telemetry
+
+# Wire frame kinds 0-2 belong to the training transport (rollout, weights,
+# heartbeat); the serve lane extends the shared kind space.
+KIND_SERVE_REQUEST = 3
+KIND_SERVE_REPLY = 4
+
+# the attach frame's request id: replies echo real request ids, which the
+# clients start at 1, so 0 is unambiguous
+ATTACH_REQUEST_ID = 0
+
+
+def encode_reply(
+    actions: np.ndarray, logp: float, version: int, slot: int,
+    request_id: int,
+) -> Any:
+    """One reply's wire bytes: packed head indices + joint logp, version
+    in ``model_version``, slot in ``env_id``, echoed request id."""
+    return encode_rollout_bytes(
+        {
+            "actions": np.asarray(actions, np.int32),
+            "logp": np.asarray(logp, np.float32),
+        },
+        model_version=version,
+        env_id=slot,
+        rollout_id=request_id,
+        length=1,
+        total_reward=0.0,
+    )
+
+
+class _ServeConn:
+    """One attached game: socket + slot + the reply queue its writer
+    drains. Only the writer thread ever writes the socket."""
+
+    __slots__ = ("sock", "slot", "cond", "replies", "dead", "bad_streak")
+
+    def __init__(self, sock: socket.socket, slot: int) -> None:
+        self.sock = sock
+        self.slot = slot
+        self.cond = threading.Condition()
+        # (actions, logp, version, request_id) tuples; encode happens on
+        # the writer thread so the batcher's reply callback stays O(1)
+        self.replies: Deque[Tuple] = deque()
+        self.dead = False
+        self.bad_streak = 0
+
+
+class PolicyServer:
+    """Listener + per-connection reader/writer threads over a ServeEngine."""
+
+    def __init__(
+        self,
+        engine: ServeEngine,
+        config: Any,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        registry: Optional[telemetry.Registry] = None,
+    ) -> None:
+        self._engine = engine
+        self._config = config
+        self._poison_frame_limit = max(
+            1, config.transport.poison_frame_limit
+        )
+        self._tel = (
+            registry if registry is not None else telemetry.get_registry()
+        )
+        self._listener = socket.create_server((host, port))
+        self.address = self._listener.getsockname()
+        self._conns: List[_ServeConn] = []
+        self._conns_lock = threading.Lock()
+        # lowest-slot-first reuse keeps the slot set compact (and makes
+        # reclamation observable: a reconnect lands on the freed slot)
+        self._free_slots: List[int] = list(range(engine.max_slots))
+        heapq.heapify(self._free_slots)
+        self._closed = threading.Event()
+        self._weights_thread: Optional[threading.Thread] = None
+        # eager-create (the --require-serve tier pins presence at zero)
+        self._tel.counter("serve/conns_rejected_total")
+        self._tel.gauge("serve/clients_connected")
+        self._tel.gauge("serve/slots_in_use")
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="serve-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    # -- threads -------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                sock, _addr = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conns_lock:
+                if not self._free_slots:
+                    # every carry slot is owned by a live game: shed the
+                    # joiner instead of degrading everyone (counted)
+                    self._tel.counter("serve/conns_rejected_total").inc()
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    continue
+                slot = heapq.heappop(self._free_slots)
+                conn = _ServeConn(sock, slot)
+                self._conns.append(conn)
+            self._publish_conn_gauges()
+            # attach frame rides the writer queue: a joiner that never
+            # reads can only wedge its own writer, never this loop
+            with conn.cond:
+                conn.replies.append(
+                    (np.zeros((len(HEADS),), np.int32), 0.0,
+                     self._engine.version, ATTACH_REQUEST_ID)
+                )
+                conn.cond.notify()
+            threading.Thread(
+                target=self._reader_loop, args=(conn,),
+                name="serve-reader", daemon=True,
+            ).start()
+            threading.Thread(
+                target=self._writer_loop, args=(conn,),
+                name="serve-writer", daemon=True,
+            ).start()
+
+    def _poison(self, conn: _ServeConn, fatal: bool = False) -> None:
+        """One corrupt/undecodable frame: count, advance the streak, and
+        quarantine (raise → connection drop → slot reclaim) at the limit —
+        the transport lane's exact discipline."""
+        self._tel.counter("transport/frames_corrupt_total").inc()
+        conn.bad_streak += 1
+        if fatal or conn.bad_streak >= self._poison_frame_limit:
+            self._tel.counter("transport/peers_quarantined").inc()
+            raise FrameCorrupt(
+                f"serve client quarantined after {conn.bad_streak} "
+                f"consecutive corrupt frames"
+            )
+
+    def _reader_loop(self, conn: _ServeConn) -> None:
+        try:
+            while not self._closed.is_set():
+                try:
+                    frame = _recv_frame(conn.sock)
+                except FramingLost:
+                    # length word untrustworthy: nothing to resync to
+                    self._poison(conn, fatal=True)   # always raises
+                except FrameCorrupt:
+                    self._poison(conn)
+                    continue
+                if frame is None:
+                    return  # clean disconnect
+                kind, payload = frame
+                if kind != KIND_SERVE_REQUEST:
+                    continue  # future control kinds: ignore, stay in sync
+                try:
+                    meta, arrays = decode_rollout_bytes(payload, upcast=True)
+                    obs = arrays["obs"]
+                    reset = bool(
+                        np.asarray(arrays["reset"]).reshape(-1)[0]
+                    )
+                    # submit validates the obs tree against the staging
+                    # lanes on THIS thread — a decodable request from a
+                    # config-skewed client (wrong max_units, missing
+                    # leaf) rides the poison path below, and the batcher
+                    # never sees an undispatable row
+                    self._engine.submit(
+                        conn.slot, obs, reset,
+                        reply=self._make_reply(conn),
+                        request_id=meta["rollout_id"],
+                    )
+                except Exception:
+                    # undecodable or lane-incompatible request
+                    # (version-skewed client): the poison discipline
+                    # covers semantic garbage too
+                    self._poison(conn)
+                    continue
+                conn.bad_streak = 0
+        except (OSError, ValueError):
+            pass  # dead/quarantined client: disposable (SURVEY.md §5.3)
+        finally:
+            self._drop(conn)
+
+    def _make_reply(self, conn: _ServeConn):
+        def reply(actions, logp, version, request_id, dispatch_idx):
+            with conn.cond:
+                if conn.dead:
+                    raise ConnectionError("serve client gone")
+                conn.replies.append((actions, logp, version, request_id))
+                conn.cond.notify()
+
+        return reply
+
+    def _writer_loop(self, conn: _ServeConn) -> None:
+        while True:
+            with conn.cond:
+                while not conn.replies and not conn.dead and not self._closed.is_set():
+                    conn.cond.wait(0.5)
+                if conn.dead or self._closed.is_set():
+                    return
+                batch = list(conn.replies)
+                conn.replies.clear()
+            try:
+                for actions, logp, version, request_id in batch:
+                    _send_frame(
+                        conn.sock, KIND_SERVE_REPLY,
+                        encode_reply(
+                            actions, logp, version, conn.slot, request_id
+                        ),
+                    )
+            except (OSError, ValueError):
+                self._drop(conn)
+                return
+
+    def _drop(self, conn: _ServeConn) -> None:
+        with self._conns_lock:
+            if conn in self._conns:
+                self._conns.remove(conn)
+                # slot back in the pool; the engine zeroes its carry row
+                # between dispatches (never mid-batch)
+                heapq.heappush(self._free_slots, conn.slot)
+                self._engine.release_slot(conn.slot)
+        with conn.cond:
+            conn.dead = True
+            conn.cond.notify_all()
+        for fn in (lambda: conn.sock.shutdown(socket.SHUT_RDWR), conn.sock.close):
+            try:
+                fn()
+            except OSError:
+                pass
+        self._publish_conn_gauges()
+
+    def _publish_conn_gauges(self) -> None:
+        with self._conns_lock:
+            n = len(self._conns)
+            in_use = self._engine.max_slots - len(self._free_slots)
+        self._tel.gauge("serve/clients_connected").set(float(n))   # host-sync-ok: host ints
+        self._tel.gauge("serve/slots_in_use").set(float(in_use))   # host-sync-ok: host ints
+
+    # -- weights subscription ------------------------------------------------
+
+    def attach_weights_source(self, source: Any) -> None:
+        """Subscribe to a weights fanout: ``source`` is any object with the
+        transports' ``latest_weights()`` surface (a ``SocketTransport``
+        connected to the learner, a ``ShmTransport`` on the same-host slab,
+        or a test stub). A dedicated thread polls at
+        ``serve.weights_poll_s``, slices each NEW version into the
+        inference tree, and hands it to the engine's between-dispatch
+        swap."""
+        if self._weights_thread is not None:
+            raise RuntimeError("weights source already attached")
+        poll_s = max(0.01, self._config.serve.weights_poll_s)
+
+        def loop() -> None:
+            last_seen = self._engine.version
+            while not self._closed.wait(poll_s):
+                try:
+                    msg = source.latest_weights()
+                except ConnectionError:
+                    return  # fanout gone: keep serving the last version
+                if msg is None or msg.version <= last_seen:
+                    continue
+                last_seen, params = weights_frame_to_params(msg)
+                self._engine.submit_weights(last_seen, params)
+
+        self._weights_thread = threading.Thread(
+            target=loop, name="serve-weights", daemon=True
+        )
+        self._weights_thread.start()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def n_connected(self) -> int:
+        with self._conns_lock:
+            return len(self._conns)
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            self._drop(conn)
+        if self._weights_thread is not None:
+            self._weights_thread.join(timeout=5)

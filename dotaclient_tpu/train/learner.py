@@ -1729,6 +1729,15 @@ def main(argv=None) -> Dict[str, float]:
         "the degenerate case of the one sharded code path)",
     )
     p.add_argument(
+        "--serve", type=str, default=None, metavar="K=V,...",
+        help="comma-separated ServeConfig overrides (policy-serving "
+        "plane, ISSUE 11), e.g. 'batch_window_ms=4,max_batch=128'. The "
+        "learner itself never serves — the knobs ride the config tree "
+        "into checkpoints, so a serve server restored from this run "
+        "(`python -m dotaclient_tpu.serve --checkpoint DIR`) starts with "
+        "them; its own --serve flag overrides at serve time",
+    )
+    p.add_argument(
         "--sync-snapshots", action="store_true",
         help="debug opt-out of the async snapshot engine (ISSUE 5): run "
         "the weights publish, periodic checkpoints, and log-boundary "
@@ -1892,6 +1901,7 @@ def main(argv=None) -> Dict[str, float]:
         MeshConfig,
         PPOConfig,
         RewardConfig,
+        ServeConfig,
     )
     from dotaclient_tpu.utils.overrides import parse_dataclass_overrides
 
@@ -1905,6 +1915,9 @@ def main(argv=None) -> Dict[str, float]:
         ("--buffer", args.buffer, "buffer", BufferConfig),
         ("--health", args.health, "health", HealthConfig),
         ("--learner", args.learner, "learner", LearnerConfig),
+        # serving-plane knobs checkpoint with the run (a serve server
+        # restored from this checkpoint starts with them)
+        ("--serve", args.serve, "serve", ServeConfig),
         # --mesh composes with the --dcn-slices/--model-parallel
         # shorthands (applied above); explicit --mesh keys win
         ("--mesh", args.mesh, "mesh", MeshConfig),

@@ -70,4 +70,12 @@ def parse_dataclass_overrides(cls: Any, text: str, flag: str) -> Dict[str, Any]:
                 f"{flag}: advantage must be one of {ADVANTAGE_MODES}, "
                 f"got {out['advantage']!r}"
             )
+    if "request_wire_dtype" in fields and out.get("request_wire_dtype") is not None:
+        from dotaclient_tpu.transport.serialize import ROLLOUT_WIRE_DTYPES
+
+        if out["request_wire_dtype"] not in ROLLOUT_WIRE_DTYPES:
+            raise ValueError(
+                f"{flag}: request_wire_dtype must be one of "
+                f"{ROLLOUT_WIRE_DTYPES}, got {out['request_wire_dtype']!r}"
+            )
     return out

@@ -149,6 +149,28 @@ MULTICHIP_KEYS = (
     "learner/psum_ms",       # startup probe: one mesh all-reduce round trip
 )
 
+# Policy-serving plane (ISSUE 11). Validated with --require-serve against
+# a serve run's JSONL (`python -m dotaclient_tpu.serve
+# --serve-metrics-jsonl PATH`): the ServeEngine and PolicyServer
+# eager-create every one of these at construction, so a server that never
+# saw a request still deterministically reports zeros.
+SERVE_KEYS = (
+    "serve/requests_total",        # step requests accepted
+    "serve/batch_fill",            # last dispatch's fill fraction
+    "serve/batch_window_hits",     # windows closed by the deadline
+    "serve/p99_latency_ms",        # arrival→reply p99 (rolling)
+    "serve/weights_version",       # version serving right now
+    "serve/dispatches_total",      # jitted dispatches run
+    "serve/max_batch_hits",        # windows closed by a full batch
+    "serve/weight_swaps_total",    # hot swaps committed between dispatches
+    "serve/dispatch_errors_total", # windows dropped by dispatch failures
+    "serve/replies_total",         # actions scattered back to requesters
+    "serve/reply_errors_total",    # replies to already-dead clients
+    "serve/clients_connected",     # attached games
+    "serve/slots_in_use",          # carry slots owned by live games
+    "serve/conns_rejected_total",  # joiners shed with every slot taken
+)
+
 # Keys only an IN-PROCESS actor emits. A learner serving external actor
 # processes over socket/shm never runs its own collect loop, so its JSONL
 # legitimately lacks these — they are waived when the line union carries an
@@ -167,9 +189,16 @@ EXTERNAL_TRANSPORT_MARKERS = (
 
 
 def validate_lines(
-    lines: List[str], extra_required: tuple = ()
+    lines: List[str],
+    extra_required: tuple = (),
+    base_required: Optional[tuple] = None,
 ) -> List[str]:
-    """Return a list of violations (empty = schema holds)."""
+    """Return a list of violations (empty = schema holds).
+
+    ``base_required`` overrides the learner-pipeline contract
+    (``REQUIRED_KEYS``) for JSONLs written by a different process class —
+    the serve plane's record (``--require-serve``) carries serve keys, not
+    actor/buffer/learner spans."""
     errors: List[str] = []
     union: Dict[str, object] = {}
     if not lines:
@@ -197,7 +226,10 @@ def validate_lines(
             elif v is not None and not isinstance(v, (int, float)):
                 errors.append(f"line {i}: scalar {k!r} is {type(v).__name__}")
         union.update(scalars)
-    required = (*REQUIRED_KEYS, *extra_required)
+    required = (
+        *(REQUIRED_KEYS if base_required is None else base_required),
+        *extra_required,
+    )
     if any(m in union for m in EXTERNAL_TRANSPORT_MARKERS):
         required = tuple(
             k for k in required if k not in IN_PROC_ACTOR_KEYS
@@ -271,6 +303,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         "the HealthMonitor eager-creates them in both snapshot modes",
     )
     p.add_argument(
+        "--require-serve", action="store_true",
+        help="also require the policy-serving-plane keys (ISSUE 11); valid "
+        "against a serve run's JSONL (--serve-metrics-jsonl) — the "
+        "ServeEngine and PolicyServer eager-create every key at "
+        "construction",
+    )
+    p.add_argument(
         "--require-multichip", action="store_true",
         help="also require the multi-chip learner keys (ISSUE 10); valid "
         "against ANY learner run's JSONL at any device count — the "
@@ -291,6 +330,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         extra += WIRE_KEYS
     if args.require_health:
         extra += HEALTH_KEYS
+    if args.require_serve:
+        extra += SERVE_KEYS
     if args.require_multichip:
         extra += MULTICHIP_KEYS
 
@@ -308,7 +349,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         with open(path) as f:
             lines = f.read().splitlines()
 
-    errors = validate_lines(lines, extra_required=extra)
+    # a serve run is a different process class: its JSONL carries the
+    # serve-plane keys, not the learner pipeline's actor/buffer spans
+    base = () if args.require_serve else None
+    errors = validate_lines(lines, extra_required=extra, base_required=base)
     if errors:
         print("telemetry schema check FAILED:", file=sys.stderr)
         for e in errors:

@@ -1,0 +1,160 @@
+"""Synthetic load generator for the policy-serving plane (ISSUE 11).
+
+Drives N concurrent synthetic clients — each one attached game sending
+sequential step requests, exactly the serve protocol's cadence — against a
+``PolicyServer`` and reports the headline serving curve: actions/sec and
+request-latency percentiles. ``bench.py``'s serve stage imports
+:func:`run_loadgen` to measure the curve at multiple batch windows; run
+standalone against a live ``python -m dotaclient_tpu.serve`` server:
+
+    python scripts/serve_loadgen.py --addr 127.0.0.1:7788 \
+        --clients 32 --requests 100
+    python scripts/serve_loadgen.py --addr 127.0.0.1:7788 \
+        --serve request_wire_dtype=bfloat16     # narrow request payloads
+
+Prints one JSON line: actions/sec, p50/p99 latency ms, reply versions seen.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, List
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # direct `python scripts/...` invocation
+    sys.path.insert(0, _REPO)
+
+
+def synthetic_obs(config, rng: np.random.Generator) -> Dict[str, np.ndarray]:
+    """One plausible random observation (unbatched leaves, template
+    dtypes/shapes; integer leaves respect the config's declared bounds so
+    the bf16 request wire's exact int casts hold)."""
+    obs_spec, act = config.obs, config.actions
+    U = obs_spec.max_units
+    return {
+        "units": rng.normal(size=(U, obs_spec.unit_features)).astype(np.float32),
+        "unit_mask": np.ones((U,), bool),
+        "unit_handles": rng.integers(0, U, size=(U,)).astype(np.int32),
+        "globals": rng.normal(size=(obs_spec.global_features,)).astype(np.float32),
+        "hero_id": np.asarray(
+            rng.integers(0, config.model.n_hero_ids), np.int32
+        ),
+        "mask_action_type": np.ones((act.n_action_types,), bool),
+        "mask_target_unit": np.ones((act.max_units,), bool),
+        "mask_cast_target": np.ones((act.max_units,), bool),
+        "mask_ability": np.ones((act.max_abilities,), bool),
+    }
+
+
+def run_loadgen(
+    host: str,
+    port: int,
+    config,
+    n_clients: int = 16,
+    requests_per_client: int = 50,
+    seed: int = 0,
+) -> Dict[str, float]:
+    """N threads × R sequential requests each; returns the serving curve
+    numbers. The wall clock covers first-send→last-reply across the whole
+    fleet, so actions/sec reflects the server's real coalescing, not a
+    single connection's round-trip ceiling."""
+    from dotaclient_tpu.serve.client import ServeClient
+
+    latencies: List[List[float]] = [[] for _ in range(n_clients)]
+    versions: set = set()
+    errors: List[str] = []
+    barrier = threading.Barrier(n_clients + 1)
+
+    def worker(ci: int) -> None:
+        rng = np.random.default_rng(seed + ci)
+        try:
+            client = ServeClient(host, port, config)
+        except Exception as e:  # attach failed (slots exhausted?)
+            errors.append(f"attach: {type(e).__name__}: {e}")
+            barrier.wait()
+            return
+        try:
+            barrier.wait()   # fleet starts together: real contention
+            for r in range(requests_per_client):
+                client.step(synthetic_obs(config, rng), reset=(r == 0))
+                latencies[ci].append(client.last_latency_s)
+                versions.add(client.last_version)
+        except Exception as e:
+            errors.append(f"step: {type(e).__name__}: {e}")
+        finally:
+            client.close()
+
+    threads = [
+        threading.Thread(target=worker, args=(i,), daemon=True)
+        for i in range(n_clients)
+    ]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    flat = sorted(s for per in latencies for s in per)
+    n = len(flat)
+    return {
+        "clients": n_clients,
+        "requests_per_client": requests_per_client,
+        "replies": n,
+        "errors": len(errors),
+        "error_sample": errors[:3],
+        "actions_per_sec": round(n / wall, 1) if wall > 0 else 0.0,
+        "p50_ms": round(flat[n // 2] * 1e3, 3) if n else 0.0,
+        "p99_ms": round(flat[min(n - 1, int(n * 0.99))] * 1e3, 3) if n else 0.0,
+        "versions_seen": sorted(versions),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--addr", type=str, required=True, help="host:port of a "
+                   "running serve server")
+    p.add_argument("--clients", type=int, default=16,
+                   help="concurrent synthetic games")
+    p.add_argument("--requests", type=int, default=50,
+                   help="sequential step requests per client")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--serve", type=str, default=None, metavar="K=V,...",
+        help="ServeConfig overrides for the CLIENT side (request encoding "
+        "only — e.g. 'request_wire_dtype=bfloat16'; must match the server)",
+    )
+    args = p.parse_args(argv)
+
+    from dotaclient_tpu.config import ServeConfig, default_config
+    from dotaclient_tpu.utils.overrides import parse_dataclass_overrides
+
+    config = default_config()
+    if args.serve:
+        try:
+            over = parse_dataclass_overrides(ServeConfig, args.serve, "--serve")
+        except ValueError as e:
+            p.error(str(e))
+        config = dataclasses.replace(
+            config, serve=dataclasses.replace(config.serve, **over)
+        )
+    host, port = args.addr.rsplit(":", 1)
+    out = run_loadgen(
+        host, int(port), config,
+        n_clients=args.clients, requests_per_client=args.requests,
+        seed=args.seed,
+    )
+    print(json.dumps(out))
+    return 0 if not out["errors"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

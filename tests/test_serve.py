@@ -1,0 +1,633 @@
+"""Policy-serving plane tests (ISSUE 11).
+
+Pins the serving contracts: the inference-only param tree (slice ==
+value_head=False init; training checkpoint and published weights frame
+restore bit-identically), the continuous-batching edge cases (deadline
+fires with a partial batch, max_batch fires before the deadline, one
+request per slot per dispatch, weight hot-swap lands between — never
+within — dispatches, carry slots reclaim and zero on disconnect and on
+quarantine), the wire lane's poison discipline, the league eval's
+bit-identity through the slim path, and the --require-serve telemetry
+tier.
+"""
+
+import dataclasses
+import socket
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from dotaclient_tpu.config import ModelConfig, RunConfig
+from dotaclient_tpu.models import make_policy
+from dotaclient_tpu.models.policy import dummy_obs_batch, init_params
+from dotaclient_tpu.serve import (
+    PolicyServer,
+    ServeClient,
+    ServeEngine,
+    load_inference_params,
+    make_inference_policy,
+    slice_train_params,
+    weights_frame_to_params,
+)
+from dotaclient_tpu.utils import telemetry
+
+
+def tiny_config(**serve_over) -> RunConfig:
+    cfg = RunConfig()
+    return dataclasses.replace(
+        cfg,
+        model=ModelConfig(unit_embed_dim=8, hidden_dim=8, hero_embed_dim=4),
+        env=dataclasses.replace(cfg.env, n_envs=2, max_dota_time=30.0),
+        ppo=dataclasses.replace(cfg.ppo, rollout_len=8, batch_rollouts=8),
+        serve=dataclasses.replace(cfg.serve, **serve_over),
+    )
+
+
+def full_params(config, seed=0):
+    policy = make_policy(config.model, config.obs, config.actions)
+    return init_params(policy, jax.random.PRNGKey(seed))
+
+
+def one_obs(config, seed=0):
+    """One deterministic synthetic observation (unbatched leaves)."""
+    from scripts.serve_loadgen import synthetic_obs
+
+    return synthetic_obs(config, np.random.default_rng(seed))
+
+
+class ReplyCollector:
+    """Thread-safe sink for engine replies."""
+
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.replies = []
+
+    def __call__(self, actions, logp, version, request_id, dispatch_idx):
+        with self.cond:
+            self.replies.append(
+                dict(
+                    actions=np.asarray(actions).copy(),
+                    logp=logp,
+                    version=version,
+                    request_id=request_id,
+                    dispatch_idx=dispatch_idx,
+                )
+            )
+            self.cond.notify_all()
+
+    def wait(self, n, timeout=60.0):
+        with self.cond:
+            ok = self.cond.wait_for(
+                lambda: len(self.replies) >= n, timeout=timeout
+            )
+        assert ok, f"only {len(self.replies)}/{n} replies arrived"
+        return sorted(self.replies, key=lambda r: r["request_id"])
+
+
+def wait_until(pred, timeout=30.0, poll=0.01):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(poll)
+    return pred()
+
+
+# -- inference-only policy path ----------------------------------------------
+
+
+def test_slice_matches_slim_init_structure():
+    config = tiny_config()
+    params = full_params(config)
+    slim = slice_train_params(params)
+    assert "head_value" in params["params"]
+    assert "head_value" not in slim["params"]
+    ref = init_params(make_inference_policy(config), jax.random.PRNGKey(0))
+    assert jax.tree_util.tree_structure(slim) == jax.tree_util.tree_structure(ref)
+    # slicing an already-slim tree is the identity (eval may hand either)
+    assert jax.tree_util.tree_structure(
+        slice_train_params(slim)
+    ) == jax.tree_util.tree_structure(slim)
+
+
+def test_slim_policy_logits_bit_identical_value_zero():
+    config = tiny_config()
+    params = full_params(config)
+    full = make_policy(config.model, config.obs, config.actions)
+    slim_policy = make_inference_policy(config)
+    obs = dummy_obs_batch(3, config.obs, config.actions)
+    obs["units"] = jax.numpy.asarray(
+        np.random.default_rng(0).normal(size=obs["units"].shape), jax.numpy.float32
+    )
+    carry = full.initial_state(3)
+    logits_f, value_f, carry_f = full.apply(params, obs, carry, method="step")
+    logits_s, value_s, carry_s = slim_policy.apply(
+        slice_train_params(params), obs, carry, method="step"
+    )
+    for h in logits_f:
+        np.testing.assert_array_equal(
+            np.asarray(logits_f[h]), np.asarray(logits_s[h])
+        )
+    np.testing.assert_array_equal(np.asarray(value_s), 0.0)
+    for a, b in zip(jax.tree.leaves(carry_f), jax.tree.leaves(carry_s)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow
+def test_restore_roundtrip_checkpoint_vs_weights_frame(tmp_path):
+    """A training checkpoint and a published weights frame load into the
+    SAME slim tree and produce identical actions (acceptance criterion)."""
+    from dotaclient_tpu.train.ppo import init_train_state
+    from dotaclient_tpu.transport.serialize import encode_weights
+    from dotaclient_tpu.utils.checkpoint import CheckpointManager
+
+    config = tiny_config(max_batch=2, max_slots=4, batch_window_ms=0.0)
+    params = full_params(config, seed=3)
+    state = init_train_state(params, config.ppo)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    assert mgr.save(state, config, force=True)
+    mgr.close()
+
+    ck_config, ck_params, ck_step = load_inference_params(
+        str(tmp_path / "ckpt")
+    )
+    assert ck_config.model == config.model
+    # the fanout path: encode at the default f32 wire (bit-exact; the
+    # bf16 fanout knob deliberately trades exactness for bytes and is
+    # out of scope for the identity pin) then decode+slice
+    msg = encode_weights(params, version=7)
+    fr_version, fr_params = weights_frame_to_params(msg)
+    assert fr_version == 7
+
+    flat_ck = jax.tree_util.tree_leaves_with_path(ck_params)
+    flat_fr = jax.tree_util.tree_leaves_with_path(fr_params)
+    assert [p for p, _ in flat_ck] == [p for p, _ in flat_fr]
+    for (path, a), (_, b) in zip(flat_ck, flat_fr):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=str(path)
+        )
+
+    # identical trees ⇒ identical actions through the SAME compiled dispatch
+    policy = make_inference_policy(config)
+    engine = ServeEngine(config, policy, ck_params)
+    try:
+        obs = one_obs(config)
+        carries0 = jax.tree.map(
+            jax.numpy.asarray, policy.initial_state(config.serve.max_slots + 1)
+        )
+        a_ck, logp_ck, _ = engine.reference_step(
+            [obs], [0], [1.0], carries0, 0
+        )
+        carries1 = jax.tree.map(
+            jax.numpy.asarray, policy.initial_state(config.serve.max_slots + 1)
+        )
+        a_fr, logp_fr, _ = engine.reference_step(
+            [obs], [0], [1.0], carries1, 0, params=fr_params
+        )
+        np.testing.assert_array_equal(a_ck, a_fr)
+        np.testing.assert_array_equal(logp_ck, logp_fr)
+    finally:
+        engine.stop()
+
+
+# -- continuous-batching edge cases -------------------------------------------
+
+
+def make_engine(config, params=None, registry=None):
+    params = params if params is not None else slice_train_params(
+        full_params(config)
+    )
+    return ServeEngine(
+        config, make_inference_policy(config), params, registry=registry
+    )
+
+
+def test_deadline_fires_with_partial_batch():
+    reg = telemetry.Registry()
+    config = tiny_config(max_batch=8, batch_window_ms=60.0, max_slots=8)
+    engine = make_engine(config, registry=reg)
+    try:
+        sink = ReplyCollector()
+        obs = one_obs(config)
+        for slot in range(3):
+            engine.submit(slot, obs, reset=True, reply=sink, request_id=slot + 1)
+        replies = sink.wait(3)
+        # all three rode ONE deadline-closed window, batch 3/8 full
+        assert {r["dispatch_idx"] for r in replies} == {replies[0]["dispatch_idx"]}
+        snap = reg.snapshot()
+        assert snap["serve/batch_window_hits"] == 1.0
+        assert snap["serve/max_batch_hits"] == 0.0
+        assert snap["serve/batch_fill"] == pytest.approx(3 / 8)
+        assert snap["serve/dispatches_total"] == 1.0
+    finally:
+        engine.stop()
+
+
+def test_max_batch_fires_before_deadline():
+    reg = telemetry.Registry()
+    # a 30 s window that must NOT be waited out: max_batch closes it
+    config = tiny_config(max_batch=2, batch_window_ms=30_000.0, max_slots=8)
+    engine = make_engine(config, registry=reg)
+    try:
+        sink = ReplyCollector()
+        obs = one_obs(config)
+        t0 = time.perf_counter()
+        for slot in range(4):
+            engine.submit(slot, obs, reset=True, reply=sink, request_id=slot + 1)
+        replies = sink.wait(4, timeout=20.0)
+        assert time.perf_counter() - t0 < 20.0  # nobody waited out 30 s
+        # two full windows of two
+        by_dispatch = {}
+        for r in replies:
+            by_dispatch.setdefault(r["dispatch_idx"], []).append(r)
+        assert sorted(len(v) for v in by_dispatch.values()) == [2, 2]
+        snap = reg.snapshot()
+        assert snap["serve/max_batch_hits"] == 2.0
+        assert snap["serve/batch_fill"] == 1.0
+    finally:
+        engine.stop()
+
+
+def test_one_request_per_slot_per_dispatch():
+    """A pipelining client's second request defers to the NEXT window —
+    duplicate carry-scatter indices can never occur, and per-slot request
+    order is preserved."""
+    config = tiny_config(max_batch=4, batch_window_ms=40.0, max_slots=4)
+    engine = make_engine(config)
+    try:
+        sink = ReplyCollector()
+        obs = one_obs(config)
+        engine.submit(0, obs, reset=True, reply=sink, request_id=1)
+        engine.submit(0, obs, reset=False, reply=sink, request_id=2)
+        replies = sink.wait(2)
+        assert replies[0]["dispatch_idx"] < replies[1]["dispatch_idx"]
+    finally:
+        engine.stop()
+
+
+def test_weight_hot_swap_between_dispatches():
+    config = tiny_config(max_batch=2, batch_window_ms=0.0, max_slots=4)
+    p1 = slice_train_params(full_params(config, seed=0))
+    p2 = slice_train_params(full_params(config, seed=1))
+    engine = make_engine(config, params=p1)
+    try:
+        sink = ReplyCollector()
+        obs = one_obs(config)
+        engine.submit(0, obs, reset=True, reply=sink, request_id=1)
+        r1 = sink.wait(1)[0]
+        assert r1["version"] == 0
+        engine.submit_weights(5, p2)
+        # the swap lands between dispatches: the next request serves v5
+        assert wait_until(lambda: engine.version == 5)
+        engine.submit(0, obs, reset=False, reply=sink, request_id=2)
+        r2 = sink.wait(2)[1]
+        assert r2["version"] == 5
+        # stale re-submit (an out-of-order fanout frame) is a no-op
+        engine.submit_weights(3, p1)
+        engine.submit(0, obs, reset=False, reply=sink, request_id=3)
+        r3 = sink.wait(3)[2]
+        assert r3["version"] == 5
+        # never WITHIN a dispatch: every reply of one dispatch shares its
+        # version (structural here — version is read once per dispatch —
+        # but pin it against a refactor)
+        by_dispatch = {}
+        for r in sink.replies:
+            by_dispatch.setdefault(r["dispatch_idx"], set()).add(r["version"])
+        assert all(len(v) == 1 for v in by_dispatch.values())
+    finally:
+        engine.stop()
+
+
+def test_hot_swap_changes_actions_deterministically():
+    """Same obs + same rng stream index, different weights ⇒ the swap is
+    real (logp moves), and replays of each version reproduce exactly."""
+    config = tiny_config(max_batch=1, batch_window_ms=0.0, max_slots=2)
+    p1 = slice_train_params(full_params(config, seed=0))
+    p2 = slice_train_params(full_params(config, seed=1))
+    policy = make_inference_policy(config)
+    engine = ServeEngine(config, policy, p1)
+    try:
+        obs = one_obs(config)
+
+        def probe(params):
+            carries = jax.tree.map(
+                jax.numpy.asarray,
+                policy.initial_state(config.serve.max_slots + 1),
+            )
+            _, logp, _ = engine.reference_step(
+                [obs], [0], [1.0], carries, 0, params=params
+            )
+            return float(logp[0])
+
+        l1, l1_again, l2 = probe(p1), probe(p1), probe(p2)
+        assert l1 == l1_again
+        assert l1 != l2
+    finally:
+        engine.stop()
+
+
+# -- wire lane: slots, quarantine, reclamation --------------------------------
+
+
+def serve_stack(config, registry=None):
+    reg = registry if registry is not None else telemetry.Registry()
+    engine = make_engine(config, registry=reg)
+    server = PolicyServer(engine, config, port=0, registry=reg)
+    return reg, engine, server
+
+
+@pytest.mark.slow
+def test_carry_slot_reuse_after_disconnect_starts_fresh():
+    config = tiny_config(max_batch=1, batch_window_ms=0.0, max_slots=2)
+    reg, engine, server = serve_stack(config)
+    host, port = server.address
+    try:
+        obs_warm = one_obs(config, seed=1)
+        obs_probe = one_obs(config, seed=2)
+        a = ServeClient(host, port, config)
+        assert a.slot == 0
+        a.step(obs_warm, reset=True)   # drive slot 0's carry off zero
+        a.step(obs_warm)
+        a.close()
+        assert wait_until(lambda: server.n_connected == 0)
+        b = ServeClient(host, port, config)
+        assert b.slot == 0             # lowest free slot: reclaimed
+        idx_before = reg.snapshot()["serve/dispatches_total"]
+        # NO reset flag: only the release-time zeroing can make this fresh
+        b.step(obs_probe, reset=False)
+        served_packed = b.last_packed.copy()
+        served_logp = b.last_logp
+        b.close()
+        # reference: a fresh carry at the SAME dispatch index
+        carries = jax.tree.map(
+            jax.numpy.asarray,
+            make_inference_policy(config).initial_state(
+                config.serve.max_slots + 1
+            ),
+        )
+        packed, logp, _ = engine.reference_step(
+            [obs_probe], [0], [0.0], carries, int(idx_before)
+        )
+        np.testing.assert_array_equal(packed[0], served_packed)
+        assert float(logp[0]) == served_logp
+    finally:
+        server.close()
+        engine.stop()
+
+
+@pytest.mark.slow
+def test_quarantined_client_slot_reclaimed():
+    from dotaclient_tpu.serve.server import KIND_SERVE_REQUEST
+    from dotaclient_tpu.transport.serialize import frame_crc32
+    from dotaclient_tpu.transport.socket_transport import _send_frame
+
+    config = dataclasses.replace(
+        tiny_config(max_batch=1, batch_window_ms=0.0, max_slots=1),
+    )
+    config = dataclasses.replace(
+        config,
+        transport=dataclasses.replace(config.transport, poison_frame_limit=2),
+    )
+    reg, engine, server = serve_stack(config)
+    host, port = server.address
+    try:
+        a = ServeClient(host, port, config)
+        assert a.slot == 0
+        # with max_slots=1 every slot is taken: a joiner is shed (counted)
+        with pytest.raises((ConnectionError, socket.timeout, OSError)):
+            ServeClient(host, port, config, timeout_s=2.0)
+        assert reg.snapshot()["serve/conns_rejected_total"] == 1.0
+        # ship poison_frame_limit corrupt frames: CRC trailer deliberately
+        # wrong (the chaos harness's corrupt_frame shape)
+        payload = b"not a rollout"
+        bad_crc = frame_crc32(payload) ^ 0xDEADBEEF
+        for _ in range(2):
+            _send_frame(a._sock, KIND_SERVE_REQUEST, payload, crc=bad_crc)
+        assert wait_until(lambda: server.n_connected == 0)
+        snap = reg.snapshot()
+        assert snap["transport/frames_corrupt_total"] >= 2.0
+        assert snap["transport/peers_quarantined"] == 1.0
+        a.close()
+        # the quarantined client's slot is reclaimed: a new game attaches
+        b = ServeClient(host, port, config)
+        assert b.slot == 0
+        b.step(one_obs(config), reset=True)
+        b.close()
+    finally:
+        server.close()
+        engine.stop()
+
+
+def test_release_slot_purges_pending_requests():
+    """A dead game's queued requests are discarded at release — a stale
+    request dispatched after the slot's zero would scatter the old game's
+    carry back into the reclaimed row."""
+    reg = telemetry.Registry()
+    config = tiny_config(max_batch=2, batch_window_ms=30_000.0, max_slots=4)
+    engine = make_engine(config, registry=reg)
+    try:
+        sink = ReplyCollector()
+        obs = one_obs(config)
+        engine.submit(0, obs, reset=True, reply=sink, request_id=1)
+        # the batcher collects req 1 into the (still-open) window...
+        assert wait_until(lambda: engine.pending == 0)
+        # ...so this dup slot is held back in pending for the NEXT window
+        engine.submit(0, obs, reset=False, reply=sink, request_id=2)
+        assert wait_until(lambda: engine.pending == 1)
+        engine.release_slot(0)   # the game died: its queued request dies too
+        # a second slot closes the 2-wide window → one dispatch
+        engine.submit(1, obs, reset=True, reply=sink, request_id=3)
+        replies = sink.wait(2)
+        assert [r["request_id"] for r in replies] == [1, 3]
+        assert wait_until(lambda: engine.pending == 0)
+        time.sleep(0.1)   # the purged request must never dispatch late
+        assert len(sink.replies) == 2
+        assert reg.snapshot()["serve/dispatches_total"] == 1.0
+    finally:
+        engine.stop()
+
+
+@pytest.mark.slow
+def test_shape_skewed_request_poisons_not_crashes():
+    """A CRC-valid, decodable request whose obs tree does not fit the
+    serving lanes (config-skewed client) rides the poison path; the
+    batcher survives and keeps serving everyone else."""
+    import dataclasses as dc
+
+    from dotaclient_tpu.serve.server import KIND_SERVE_REQUEST
+    from dotaclient_tpu.transport.serialize import encode_rollout_bytes
+    from dotaclient_tpu.transport.socket_transport import _send_frame
+
+    config = tiny_config(max_batch=2, batch_window_ms=0.0, max_slots=4)
+    config = dc.replace(
+        config,
+        transport=dc.replace(config.transport, poison_frame_limit=2),
+    )
+    reg, engine, server = serve_stack(config)
+    host, port = server.address
+    try:
+        skewed = ServeClient(host, port, config)
+        good = ServeClient(host, port, config)
+        bad_obs = one_obs(config)
+        bad_obs["units"] = np.zeros((64, 7), np.float32)   # wrong ObsSpec
+        payload = encode_rollout_bytes(
+            {"obs": bad_obs, "reset": np.asarray(1.0, np.float32)},
+            model_version=0, env_id=skewed.slot, rollout_id=1,
+            length=1, total_reward=0.0,
+        )
+        for _ in range(2):
+            _send_frame(skewed._sock, KIND_SERVE_REQUEST, payload)
+        assert wait_until(lambda: server.n_connected == 1)   # quarantined
+        snap = reg.snapshot()
+        assert snap["transport/peers_quarantined"] == 1.0
+        assert snap["serve/dispatch_errors_total"] == 0.0   # never dispatched
+        # the well-configured client is unaffected
+        good.step(one_obs(config), reset=True)
+        assert reg.snapshot()["serve/replies_total"] == 1.0
+        good.close()
+        skewed.close()
+    finally:
+        server.close()
+        engine.stop()
+
+
+def test_weights_subscription_slices_and_swaps():
+    """attach_weights_source: a fanout frame (the snapshot engine's
+    publish format) is polled, sliced into the slim tree, and hot-swapped
+    — monotonic, between dispatches."""
+    from dotaclient_tpu.transport.serialize import encode_weights
+
+    config = tiny_config(
+        max_batch=1, batch_window_ms=0.0, max_slots=2, weights_poll_s=0.02
+    )
+    full = full_params(config, seed=0)
+    reg, engine, server = serve_stack(config)
+
+    class StubFanout:
+        def __init__(self):
+            self.msg = None
+
+        def latest_weights(self):
+            return self.msg
+
+    source = StubFanout()
+    try:
+        server.attach_weights_source(source)
+        source.msg = encode_weights(full_params(config, seed=2), version=9)
+        assert wait_until(lambda: engine.version == 9)
+        snap = reg.snapshot()
+        assert snap["serve/weights_version"] == 9.0
+        assert snap["serve/weight_swaps_total"] == 1.0
+        # an older frame left in the slot is never applied backwards
+        source.msg = encode_weights(full, version=4)
+        time.sleep(0.1)
+        assert engine.version == 9
+    finally:
+        server.close()
+        engine.stop()
+
+
+# -- league eval through the serving plane ------------------------------------
+
+
+@pytest.mark.slow
+def test_evaluate_bit_identical_to_full_policy_path():
+    """The eval satellite's pin: routing evaluate() through the
+    inference-only path changes NOTHING — win rate, episode count, and
+    reward mean are bit-identical to the training-shaped policy driving
+    the same eval loop (eval discards values; sampling is untouched)."""
+    from dotaclient_tpu.actor.device_rollout import DeviceActor
+    from dotaclient_tpu.league import evaluate
+
+    config = tiny_config()
+    params = full_params(config, seed=5)
+    policy = make_policy(config.model, config.obs, config.actions)
+    n_games, seed = 4, 11
+    out = evaluate(
+        config, policy, params, "scripted_easy", n_games=n_games, seed=seed
+    )
+
+    # reference: the pre-ISSUE-11 behavior — the FULL training-shaped
+    # policy on the same eval loop (mirrors evaluate()'s body exactly)
+    eval_cfg = dataclasses.replace(
+        config,
+        env=dataclasses.replace(
+            config.env, n_envs=n_games, opponent="scripted_easy"
+        ),
+        league=dataclasses.replace(config.league, anchor_prob=0.0),
+        transport=dataclasses.replace(
+            config.transport, rollout_wire_dtype="float32"
+        ),
+    )
+    actor = DeviceActor(
+        eval_cfg, policy, seed=seed, registry=telemetry.Registry()
+    )
+    steps_per_episode = eval_cfg.env.max_dota_time / (
+        eval_cfg.env.ticks_per_observation / 30.0
+    )
+    max_chunks = int(2 * steps_per_episode / config.ppo.rollout_len + 2)
+    for i in range(max_chunks):
+        actor.collect(params)
+        if i % 8 == 7:
+            if actor.drain_stats()["episodes_done"] >= n_games:
+                break
+    stats = actor.drain_stats()
+    assert out["win_rate"] == stats["win_rate"]
+    assert out["episodes"] == stats["episodes_done"]
+    assert out["episode_reward_mean"] == stats["episode_reward_mean"]
+
+
+@pytest.mark.slow
+def test_evaluate_served_plays_full_games():
+    """The serving plane's first client: full eval games over the wire."""
+    from dotaclient_tpu.league import evaluate_served
+
+    config = tiny_config(max_batch=4, batch_window_ms=1.0, max_slots=8)
+    reg, engine, server = serve_stack(config)
+    host, port = server.address
+    try:
+        out = evaluate_served(
+            config, (host, port), opponent="scripted_easy", n_games=2,
+            seed=3,
+        )
+        assert out["episodes"] >= 2
+        assert 0.0 <= out["win_rate"] <= 1.0
+        snap = reg.snapshot()
+        assert snap["serve/requests_total"] > 0
+        assert snap["serve/dispatches_total"] > 0
+    finally:
+        server.close()
+        engine.stop()
+
+
+# -- telemetry tier ------------------------------------------------------------
+
+
+def test_require_serve_schema_tier(tmp_path):
+    """A serve process's JSONL satisfies --require-serve at construction —
+    every key is eager-created, a zero-traffic server still validates."""
+    import sys
+
+    sys.path.insert(0, str(tmp_path))  # no-op; keeps import order explicit
+    from scripts.check_telemetry_schema import SERVE_KEYS, validate_lines
+
+    reg = telemetry.Registry()
+    config = tiny_config(max_batch=1, batch_window_ms=0.0, max_slots=2)
+    engine = make_engine(config, registry=reg)
+    server = PolicyServer(engine, config, port=0, registry=reg)
+    try:
+        path = tmp_path / "serve.jsonl"
+        sink = telemetry.JsonlSink(str(path))
+        sink.emit(0, reg.snapshot())
+        sink.close()
+        lines = path.read_text().splitlines()
+        errors = validate_lines(
+            lines, extra_required=SERVE_KEYS, base_required=()
+        )
+        assert errors == [], errors
+    finally:
+        server.close()
+        engine.stop()
