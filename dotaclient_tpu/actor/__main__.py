@@ -139,6 +139,16 @@ def main(argv=None) -> int:
     p.add_argument("--max-reconnects", type=int, default=6,
                    help="bounded connect attempts (exponential backoff + "
                         "jitter) before exiting non-zero for the supervisor")
+    p.add_argument("--trace-jsonl", type=str, default=None, metavar="PATH",
+                   help="pipeline tracing (ISSUE 12): append sampled "
+                        "lifecycle events (shipped-chunk trace records, "
+                        "weight-apply stamps) as JSON lines to PATH; merge "
+                        "with the learner's log via "
+                        "scripts/trace_report.py. Off by default")
+    p.add_argument("--trace-sample", type=int, default=None, metavar="N",
+                   help="with --trace-jsonl: trace every Nth shipped "
+                        "chunk (default telemetry.trace_sample_n = 16; "
+                        "1 = every chunk)")
     p.add_argument("--idle-timeout", type=float, default=None,
                    help="seconds of learner silence (no weights OR "
                         "heartbeats) before declaring the connection "
@@ -188,6 +198,11 @@ def main(argv=None) -> int:
     from dotaclient_tpu.config import default_config
     from dotaclient_tpu.models import init_params, make_policy
     from dotaclient_tpu.transport import decode_weights
+    from dotaclient_tpu.utils import tracing
+
+    if args.trace_jsonl:
+        # before the pool exists: it captures tracing.get() at init
+        tracing.configure(args.trace_jsonl, sample_n=args.trace_sample)
 
     config = default_config()
     config = dataclasses.replace(
@@ -344,6 +359,9 @@ def main(argv=None) -> int:
         + _json.dumps(sorted(pool.versions_applied)),
         flush=True,
     )
+    if args.trace_jsonl:
+        tracing.shutdown()   # drain + fsync (a SIGKILL skips this — the
+        # writer's per-batch flush + torn-line reader cover that corpse)
     try:
         transport.close()
     except OSError:

@@ -26,7 +26,7 @@ import numpy as np
 
 from dotaclient_tpu.actor.window_stats import WindowedStatsMixin
 from dotaclient_tpu.config import RunConfig
-from dotaclient_tpu.utils import faults, telemetry
+from dotaclient_tpu.utils import faults, telemetry, tracing
 from dotaclient_tpu.envs.vec_lane_sim import (
     OPPONENT_CONTROL,
     VecLaneSim,
@@ -169,6 +169,15 @@ class VecActorPool(WindowedStatsMixin):
         self.wins = 0
         self._tel = telemetry.get_registry()
         self._faults = faults.get()   # None unless chaos injection is on
+        # Pipeline tracing (ISSUE 12): the tracer is captured ONCE, like
+        # the fault registry — with tracing off the ship path pays exactly
+        # one `is not None` test per emit batch (pinned by test). Per-lane
+        # chunk-start stamps exist only when tracing is on.
+        self._tracer = tracing.get()
+        self._actor_tag = seed & 0xFFFF
+        self._chunk_start = (
+            np.full((L,), tracing.now()) if self._tracer is not None else None
+        )
         # Rollout wire narrowing (ISSUE 7): encode-time kwargs derived once
         # from config. In-proc delivery (rollout_sink) ships full-width
         # decoded arrays; the learner's buffer quantizes at its own door
@@ -217,6 +226,17 @@ class VecActorPool(WindowedStatsMixin):
         version, tree = decode_weights(msg)
         self._weights = (jax.tree.map(jnp.asarray, tree), version)
         self.versions_applied.add(version)
+        if self._tracer is not None:
+            # staleness attribution (ISSUE 12): the publish-side trace
+            # record (when the learner traces too) dates this version's
+            # fanout; the apply event closes the publish→apply leg
+            from dotaclient_tpu.transport.serialize import weights_trace
+
+            rec = tracing.parse_blob(weights_trace(msg) or b"")
+            publish_ts = rec["hops"][0][1] if rec and rec["hops"] else None
+            self._tracer.emit(
+                "apply", version=int(version), publish_ts=publish_ts
+            )
         return True
 
     # -- stepping ----------------------------------------------------------
@@ -313,6 +333,7 @@ class VecActorPool(WindowedStatsMixin):
         cfg = self.config
         T = cfg.ppo.rollout_len
         out: List[DecodedRollout] = []
+        blobs: List[Optional[bytes]] = []   # wire trace blob per chunk
         for l in lanes:
             n = int(self._cursor[l])
             done = bool(done_lane[l])
@@ -346,6 +367,30 @@ class VecActorPool(WindowedStatsMixin):
                 "total_reward": float(self._rew_buf[l, :n].sum()),
             }
             self._next_rollout_id += 1
+            trace_blob = None
+            if self._tracer is not None:
+                # per-lane chunk window: collect = when this lane's chunk
+                # began accumulating (previous emit / pool start)
+                collect_ts = float(self._chunk_start[l])
+                self._chunk_start[l] = tracing.now()
+                if self._tracer.should_sample():
+                    rec = tracing.new_record(
+                        self._tracer.next_tid(self._actor_tag),
+                        self._actor_tag,
+                        meta["model_version"],
+                    )
+                    rec["hops"].append(["collect", collect_ts])
+                    tracing.append_hop(rec, "encode")
+                    # actor-side partial record (the merge's origin-side
+                    # evidence even when this process is later SIGKILLed)
+                    self._tracer.emit_chunk(rec)
+                    if self.rollout_sink is not None:
+                        # in-proc delivery: the host record rides the meta
+                        # directly — downstream hops append to it in place
+                        meta["trace"] = rec
+                    else:
+                        trace_blob = tracing.record_to_blob(rec)
+            blobs.append(trace_blob)
             if self._faults is not None and self._faults.fire(
                 "actor.nonfinite_payload"
             ):
@@ -377,16 +422,18 @@ class VecActorPool(WindowedStatsMixin):
             publish_bytes = getattr(
                 self.transport, "publish_rollout_bytes", None
             )
-            for meta, arrays in out:
+            for (meta, arrays), blob in zip(out, blobs):
                 if publish_bytes is not None:
                     publish_bytes(
                         encode_rollout_bytes(
-                            arrays, **meta, **self._wire_kwargs
+                            arrays, **meta, **self._wire_kwargs, trace=blob
                         )
                     )
                 else:
                     self.transport.publish_rollout(
-                        encode_rollout(arrays, **meta, **self._wire_kwargs)
+                        encode_rollout(
+                            arrays, **meta, **self._wire_kwargs, trace=blob
+                        )
                     )
         self.rollouts_shipped += len(out)
 

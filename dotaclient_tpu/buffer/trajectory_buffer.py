@@ -28,7 +28,7 @@ from jax.sharding import Mesh
 
 from dotaclient_tpu.config import RunConfig
 from dotaclient_tpu.train.ppo import example_batch
-from dotaclient_tpu.utils import telemetry
+from dotaclient_tpu.utils import telemetry, tracing
 
 logger = logging.getLogger(__name__)
 
@@ -254,6 +254,17 @@ class TrajectoryBuffer:
         # full-capacity ingest (monotone in n, so the cap is the max).
         self._staging_rows = self._pad_rows(cap)
 
+        # Pipeline tracing (ISSUE 12): captured once, the faults/tracer
+        # discipline — with tracing off every ingest/consume pays one
+        # `is not None` test. Traced slots remember their host record
+        # across ring residency so gather/dispatch hops can close the
+        # chunk's timeline (the learner emits at dispatch).
+        self._tracer = tracing.get()
+        self._slot_trace: Optional[List[Optional[dict]]] = (
+            [None] * cap if self._tracer is not None else None
+        )
+        self._pending_traces: List[dict] = []
+
         # Retrace accounting (ADVICE round 1): every distinct rows leading
         # dim compiles one XLA program. Host ingest pads to shard-divisible
         # pow2 buckets and the device path scatters pow2 chunks, so the
@@ -280,15 +291,21 @@ class TrajectoryBuffer:
         # (n_devices × the bytes; measured via compiled input shardings) —
         # the single-device-memory scatter ISSUE 10 exists to fix.
         # _pad_rows guarantees the leading dim divides by n_data.
-        self._scatter = jax.jit(
-            _scatter_impl,
-            donate_argnums=(0,),
-            in_shardings=(
-                store_shardings,
-                jax.tree.map(lambda _: self._sharding, template),
-                replicated(mesh),
+        # instrument_jit (ISSUE 12): compile/retrace accounting per
+        # program; transparent to dispatch AND to the donation lint
+        # (lint/donation.py unwraps it) and to `.lower(...)` introspection
+        self._scatter = tracing.instrument_jit(
+            jax.jit(
+                _scatter_impl,
+                donate_argnums=(0,),
+                in_shardings=(
+                    store_shardings,
+                    jax.tree.map(lambda _: self._sharding, template),
+                    replicated(mesh),
+                ),
+                out_shardings=store_shardings,
             ),
-            out_shardings=store_shardings,
+            "buffer_scatter",
         )
         # DEVICE ingest path (add_device): rows are committed slices of an
         # in-process chunk (whatever sharding the producing program left
@@ -296,20 +313,28 @@ class TrajectoryBuffer:
         # committed args whose sharding mismatches); no H2D happens here,
         # the program reshards in HBM. Separate jit so the two paths'
         # programs never mix; same impl, same trace bound.
-        self._scatter_dev = jax.jit(
-            _scatter_impl,
-            donate_argnums=(0,),
-            out_shardings=store_shardings,
+        self._scatter_dev = tracing.instrument_jit(
+            jax.jit(
+                _scatter_impl,
+                donate_argnums=(0,),
+                out_shardings=store_shardings,
+            ),
+            "buffer_scatter_dev",
         )
         # Consume-time upcast (ISSUE 7): the gather restores the train
         # dtypes in the same jitted program — the only place narrow rows
         # widen, and it runs on-device (no host copy ever sees f32).
         consume_dtypes = self._consume_dtypes
-        self._gather = jax.jit(
-            lambda store, idx: jax.tree.map(
-                lambda s, d: s[idx].astype(d), store, consume_dtypes
+        self._gather = tracing.instrument_jit(
+            jax.jit(
+                lambda store, idx: jax.tree.map(
+                    lambda s, d: s[idx].astype(d), store, consume_dtypes
+                ),
+                out_shardings=jax.tree.map(
+                    lambda _: self._sharding, template
+                ),
             ),
-            out_shardings=jax.tree.map(lambda _: self._sharding, template),
+            "buffer_gather",
         )
 
     def _pad_rows(self, n: int) -> int:
@@ -436,6 +461,16 @@ class TrajectoryBuffer:
             self._slot_version[idx[:n]] = [
                 m["model_version"] for m, _ in fresh
             ]
+            if self._tracer is not None:
+                # admission hop: the row passed the door and owns a slot.
+                # Untraced rows CLEAR the slot's record — a reused slot
+                # must never inherit an evicted chunk's timeline.
+                ts = tracing.now()
+                for (m, _), s in zip(fresh, slots):
+                    rec = m.get("trace")
+                    if rec is not None:
+                        rec["hops"].append(["admit", ts])
+                    self._slot_trace[s] = rec
             self._order.extend(slots)
             self.ingested += n
         self._publish_telemetry()
@@ -592,6 +627,13 @@ class TrajectoryBuffer:
                 )
                 pos += n
                 remaining -= n
+            if self._slot_trace is not None:
+                # device chunks are untraced, but the slots they claim may
+                # have been evicted from under a traced host row — a
+                # reused slot must never inherit that chunk's timeline
+                # (same invariant the host-path assignment keeps)
+                for s in slots:
+                    self._slot_trace[s] = None
             self._slot_version[idx] = version
             self._order.extend(slots)
             self.ingested += take
@@ -657,6 +699,20 @@ class TrajectoryBuffer:
                 self._held[ticket] = [int(s) for s in idx]
             else:
                 self._free.extend(int(s) for s in idx)
+            if self._tracer is not None:
+                # consume-gather hop: the slot left the ring in this batch
+                # (ring residency = gather − admit). The records park in
+                # _pending_traces until the learner stamps `dispatch` on
+                # the batch they ride (drain_traces) — a requeued batch's
+                # records attribute to the NEXT dispatch, a documented
+                # end-of-run approximation.
+                ts = tracing.now()
+                for s in idx:
+                    rec = self._slot_trace[int(s)]
+                    if rec is not None:
+                        self._slot_trace[int(s)] = None
+                        rec["hops"].append(["gather", ts])
+                        self._pending_traces.append(rec)
         if current_version is not None:
             # host-side ints: how far behind the optimizer the experience in
             # this batch is, in optimizer steps (the IMPACT-style staleness
@@ -666,6 +722,15 @@ class TrajectoryBuffer:
             )
         self._publish_telemetry()
         return (batch, ticket) if hold else batch
+
+    def drain_traces(self) -> List[dict]:
+        """Hand off the trace records of every batch gathered since the
+        last call (ISSUE 12) — the learner stamps ``dispatch`` and emits
+        them. Empty (and allocation-free) when tracing is off."""
+        if not self._pending_traces:
+            return self._pending_traces
+        out, self._pending_traces = self._pending_traces, []
+        return out
 
     def release(self, ticket: int) -> None:
         """The held batch was consumed — its slots become reusable.
@@ -773,6 +838,10 @@ class TrajectoryBuffer:
         )
         self._free = [int(s) for s in np.asarray(state["free"]) if s >= 0]
         self._held = {}   # snapshots never carry in-flight holds
+        if self._slot_trace is not None:
+            # restored slots carry no live trace timeline
+            self._slot_trace = [None] * self.capacity
+            self._pending_traces = []
         self._slot_version = np.asarray(state["slot_version"]).copy()
         counters = [int(v) for v in np.asarray(state["counters"])]
         # snapshots written before dropped_skew/dropped_nonfinite/

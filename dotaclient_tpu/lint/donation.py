@@ -63,6 +63,19 @@ from dotaclient_tpu.lint.core import (
 UNTRACKABLE = "untrackable"
 
 
+def _unwrap_instrumented(node: ast.AST) -> ast.AST:
+    """See through ``tracing.instrument_jit(<jit-or-factory-call>, ...)``
+    (ISSUE 12): the wrapper is call-transparent, so the donation spec of
+    its first argument IS the spec of the wrapped callable. Without this,
+    instrumenting a donating jit would silently drop its taint tracking —
+    the exact blindness this pass exists to prevent."""
+    if isinstance(node, ast.Call) and node.args:
+        callee = dotted_name(node.func)
+        if callee and callee.rsplit(".", 1)[-1] == "instrument_jit":
+            return node.args[0]
+    return node
+
+
 def _donated_positions(call: ast.Call):
     """Literal donate_argnums of a jit/pjit call; () for a jit without
     donation; :data:`UNTRACKABLE` when it donates but the positions are
@@ -94,7 +107,9 @@ def _donated_positions(call: ast.Call):
 
 def _donating_call_spec(node: ast.AST) -> Optional[Tuple[int, ...]]:
     """Donated positions when ``node`` is a jit/pjit call WITH literal,
-    trackable donation (UNTRACKABLE specs report separately)."""
+    trackable donation (UNTRACKABLE specs report separately). An
+    ``instrument_jit(...)`` wrapper is transparent."""
+    node = _unwrap_instrumented(node)
     if not isinstance(node, ast.Call):
         return None
     pos = _donated_positions(node)
@@ -259,9 +274,10 @@ def analyze_module(
     donating: Dict[str, Tuple[int, ...]] = {}
     for node in ast.walk(ctx.tree):
         if isinstance(node, ast.Assign):
-            spec = _donating_call_spec(node.value)
-            if spec is None and isinstance(node.value, ast.Call):
-                callee = dotted_name(node.value.func)
+            inner = _unwrap_instrumented(node.value)
+            spec = _donating_call_spec(inner)
+            if spec is None and isinstance(inner, ast.Call):
+                callee = dotted_name(inner.func)
                 if callee:
                     spec = factories.get(callee.rsplit(".", 1)[-1])
             if spec:

@@ -227,6 +227,32 @@ OWNERSHIP: Dict[str, Dict[str, ClassMap]] = {
             },
         ),
     },
+    "dotaclient_tpu/utils/tracing.py": {
+        # Trace writer (ISSUE 12): the SnapshotEngine division of labor
+        # applied to trace events — any pipeline thread enqueues
+        # (lock-free, GIL-atomic deque append), ONE writer thread owns
+        # the file. The map pins that: the first future "quick fix" that
+        # writes the file from a producer thread trips this pass, not a
+        # reviewer (regression fixture in tests/test_lint.py).
+        "TraceWriter": ClassMap(
+            default_thread="producer",
+            methods={
+                "_run": "writer",
+                # close() joins the writer before touching the file —
+                # the post-join access is waived at the line
+                "close": "any",
+            },
+            attrs={
+                # the file handle is the writer's alone
+                "_f": "writer",
+                # bounded deque: append (producers) and popleft (writer)
+                # are each GIL-atomic; no lock by design
+                "_queue": "any",
+                # latched stop flag: single bool write, stale reads fine
+                "_stopped": "any",
+            },
+        ),
+    },
     "dotaclient_tpu/transport/shm_transport.py": {
         # Single-consumer by design: every method runs on the learner
         # thread (no background threads in the shm server — liveness is

@@ -57,9 +57,24 @@ _EMIT_METHODS = ("counter", "gauge", "timer", "span")
 # Declared expansions for f-string keys: (constant prefix, constant
 # suffix) → the values the formatted hole takes. Keep in sync with the
 # emitting site's comment.
+_INSTRUMENTED_PROGRAMS = (
+    # every instrument_jit(..., name) site in the package (ISSUE 12):
+    # learner, buffer, and serve jit entry points. A NEW instrumented
+    # program must be added here (its per-program compile keys are
+    # f-strings in utils/tracing.py) and is covered by the
+    # `compile/<program>/...` wildcard rows in ARCHITECTURE.md.
+    "train_step", "epoch_step", "fused_step", "minibatch_gather",
+    "snap_copy", "buffer_scatter", "buffer_scatter_dev", "buffer_gather",
+    "serve_dispatch",
+)
+
 DYNAMIC_KEY_EXPANSIONS: Dict[Tuple[str, str], Tuple[str, ...]] = {
     # train/snapshot.py: one coalesce counter per job slot kind (_KINDS)
     ("snapshot/", "_coalesced"): ("publish", "checkpoint", "metrics"),
+    # utils/tracing.py InstrumentedJit: per-program compile accounting
+    ("compile/", "/compiles_total"): _INSTRUMENTED_PROGRAMS,
+    ("compile/", "/retraces_total"): _INSTRUMENTED_PROGRAMS,
+    ("compile/", "/last_compile_s"): _INSTRUMENTED_PROGRAMS,
 }
 
 # Token shape of a telemetry key in backticked doc text: slash-separated
@@ -74,9 +89,9 @@ _DOC_KEY_RE = re.compile(
 # `carry0/*`) — never treated as documented-telemetry claims. A NEW
 # namespace must be added here when its first key is minted.
 KEY_PREFIXES = (
-    "actor/", "buffer/", "checkpoint/", "faults/", "health/", "league/",
-    "learner/", "mesh/", "serve/", "shm/", "snapshot/", "span/",
-    "transport/",
+    "actor/", "buffer/", "checkpoint/", "compile/", "faults/", "health/",
+    "league/", "learner/", "mem/", "mesh/", "serve/", "shm/", "snapshot/",
+    "span/", "trace/", "transport/",
 )
 # single-line inline code only: multi-line matches would mispair across
 # ``` fence lines (odd backtick count flips pairing for the whole doc)

@@ -50,6 +50,15 @@ def main(argv=None) -> int:
         "object per interval; step = dispatch count) to PATH — validate "
         "with scripts/check_telemetry_schema.py --path PATH --require-serve",
     )
+    p.add_argument("--trace-jsonl", type=str, default=None, metavar="PATH",
+                   help="pipeline tracing (ISSUE 12): append sampled "
+                   "lifecycle events (request/reply trace records, "
+                   "per-compile cost analysis) as JSON lines to PATH; "
+                   "merge with a learner/actor run's logs via "
+                   "scripts/trace_report.py")
+    p.add_argument("--trace-sample", type=int, default=None, metavar="N",
+                   help="with --trace-jsonl: trace every Nth request "
+                   "(default telemetry.trace_sample_n = 16)")
     p.add_argument("--duration", type=float, default=0.0,
                    help="serve for this many seconds then exit (0 = forever)")
     args = p.parse_args(argv)
@@ -74,6 +83,12 @@ def main(argv=None) -> int:
         config = dataclasses.replace(
             config, serve=dataclasses.replace(config.serve, **over)
         )
+
+    if args.trace_jsonl:
+        from dotaclient_tpu.utils import tracing
+
+        # before the engine/server exist — they capture tracing.get()
+        tracing.configure(args.trace_jsonl, sample_n=args.trace_sample)
 
     policy = make_inference_policy(config)
     engine = ServeEngine(config, policy, params, version=version)
@@ -122,6 +137,10 @@ def main(argv=None) -> int:
             sink.close()
         server.close()
         engine.stop()
+        if args.trace_jsonl:
+            from dotaclient_tpu.utils import tracing
+
+            tracing.shutdown()
         snap = tel.snapshot()
         print(json.dumps({
             "serve_requests_total": snap.get("serve/requests_total", 0.0),

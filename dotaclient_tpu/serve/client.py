@@ -40,6 +40,7 @@ from dotaclient_tpu.transport.serialize import (
     encode_rollout_bytes,
     rollout_int_bounds,
 )
+from dotaclient_tpu.utils import tracing
 
 
 def serve_request_wire_kwargs(config: RunConfig) -> Dict[str, Any]:
@@ -110,6 +111,16 @@ class ServeClient:
         ``last_latency_s``."""
         request_id = self._next_id
         self._next_id += 1
+        trace_blob = None
+        tracer = tracing.get()
+        if tracer is not None and tracer.should_sample():
+            # request-side trace record (ISSUE 12): the server stamps
+            # recv/reply and echoes it; `done` below closes the RTT
+            rec = tracing.new_record(
+                tracer.next_tid(self.slot), self.slot, self.last_version
+            )
+            tracing.append_hop(rec, "encode")
+            trace_blob = tracing.record_to_blob(rec, pad=False)
         payload = encode_rollout_bytes(
             {
                 "obs": obs,
@@ -121,11 +132,17 @@ class ServeClient:
             length=1,
             total_reward=0.0,
             **self._wire_kwargs,
+            trace=trace_blob,
         )
         t0 = time.perf_counter()
         _send_frame(self._sock, KIND_SERVE_REQUEST, payload)
         meta, arrays = self._recv_reply(request_id)
         self.last_latency_s = time.perf_counter() - t0
+        if tracer is not None and "trace_blob" in meta:
+            rec = tracing.parse_blob(meta["trace_blob"])
+            if rec is not None:
+                tracing.append_hop(rec, "done")
+                tracer.emit_chunk(rec)
         self.last_version = meta["model_version"]
         self._last_packed = np.asarray(arrays["actions"]).astype(np.int32)
         self.last_logp = float(np.asarray(arrays["logp"]).reshape(-1)[0])

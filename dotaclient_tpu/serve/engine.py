@@ -147,8 +147,18 @@ class ServeEngine:
             )
             return packed, logp.astype(jnp.float32), new_carries
 
-        # carries donated: the store updates in place in HBM every dispatch
-        self._dispatch_fn = jax.jit(_dispatch_impl, donate_argnums=(4,))
+        # carries donated: the store updates in place in HBM every dispatch.
+        # instrument_jit (ISSUE 12): serve recompiles are latency cliffs —
+        # the per-program compile counters name them; the donation lint
+        # unwraps the wrapper, so the call site keeps its taint tracking.
+        from dotaclient_tpu.utils import tracing
+
+        tracing.ensure_metrics(self._tel)
+        self._dispatch_fn = tracing.instrument_jit(
+            jax.jit(_dispatch_impl, donate_argnums=(4,)),
+            "serve_dispatch",
+            self._tel,
+        )
 
         def _zero_slots_impl(carries, slots):
             return jax.tree.map(

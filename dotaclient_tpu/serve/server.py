@@ -57,7 +57,7 @@ from dotaclient_tpu.transport.serialize import (
     decode_rollout_bytes,
     encode_rollout_bytes,
 )
-from dotaclient_tpu.utils import telemetry
+from dotaclient_tpu.utils import telemetry, tracing
 
 # Wire frame kinds 0-2 belong to the training transport (rollout, weights,
 # heartbeat); the serve lane extends the shared kind space.
@@ -71,10 +71,12 @@ ATTACH_REQUEST_ID = 0
 
 def encode_reply(
     actions: np.ndarray, logp: float, version: int, slot: int,
-    request_id: int,
+    request_id: int, trace: "bytes | None" = None,
 ) -> Any:
     """One reply's wire bytes: packed head indices + joint logp, version
-    in ``model_version``, slot in ``env_id``, echoed request id."""
+    in ``model_version``, slot in ``env_id``, echoed request id. A traced
+    request's record (recv/reply hops appended server-side) rides back
+    in-band (ISSUE 12) so the client can close the round trip."""
     return encode_rollout_bytes(
         {
             "actions": np.asarray(actions, np.int32),
@@ -85,6 +87,7 @@ def encode_reply(
         rollout_id=request_id,
         length=1,
         total_reward=0.0,
+        trace=trace,
     )
 
 
@@ -92,7 +95,9 @@ class _ServeConn:
     """One attached game: socket + slot + the reply queue its writer
     drains. Only the writer thread ever writes the socket."""
 
-    __slots__ = ("sock", "slot", "cond", "replies", "dead", "bad_streak")
+    __slots__ = (
+        "sock", "slot", "cond", "replies", "dead", "bad_streak", "traces",
+    )
 
     def __init__(self, sock: socket.socket, slot: int) -> None:
         self.sock = sock
@@ -103,6 +108,10 @@ class _ServeConn:
         self.replies: Deque[Tuple] = deque()
         self.dead = False
         self.bad_streak = 0
+        # request_id → trace record for TRACED requests only (ISSUE 12):
+        # written by the reader, popped by the writer, both under `cond`;
+        # dropped with the connection
+        self.traces: dict = {}
 
 
 class PolicyServer:
@@ -214,6 +223,20 @@ class PolicyServer:
                     continue  # future control kinds: ignore, stay in sync
                 try:
                     meta, arrays = decode_rollout_bytes(payload, upcast=True)
+                    tracer = tracing.get()
+                    if tracer is not None and "trace_blob" in meta:
+                        # serve request hop (ISSUE 12): receive + CRC
+                        # verify happened in _recv_frame just above; the
+                        # record rides to the writer for the reply stamp
+                        rec = tracing.stamp_serve_recv(meta)
+                        if rec is not None:
+                            tracer.emit(
+                                "serve_request",
+                                tid=rec["tid"],
+                                slot=conn.slot,
+                            )
+                            with conn.cond:
+                                conn.traces[meta["rollout_id"]] = rec
                     obs = arrays["obs"]
                     reset = bool(
                         np.asarray(arrays["reset"]).reshape(-1)[0]
@@ -259,12 +282,23 @@ class PolicyServer:
                     return
                 batch = list(conn.replies)
                 conn.replies.clear()
+                reply_traces = {
+                    rid: conn.traces.pop(rid)
+                    for _a, _l, _v, rid in batch
+                    if rid in conn.traces
+                } if conn.traces else {}
             try:
                 for actions, logp, version, request_id in batch:
+                    blob = None
+                    rec = reply_traces.get(request_id)
+                    if rec is not None:
+                        tracing.append_hop(rec, "reply")
+                        blob = tracing.record_to_blob(rec, pad=False)
                     _send_frame(
                         conn.sock, KIND_SERVE_REPLY,
                         encode_reply(
-                            actions, logp, version, conn.slot, request_id
+                            actions, logp, version, conn.slot, request_id,
+                            trace=blob,
                         ),
                     )
             except (OSError, ValueError):

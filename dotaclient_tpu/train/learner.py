@@ -79,7 +79,7 @@ from dotaclient_tpu.transport import (
     decode_rollout,
     encode_weights,
 )
-from dotaclient_tpu.utils import faults, telemetry
+from dotaclient_tpu.utils import faults, telemetry, tracing
 from dotaclient_tpu.utils.checkpoint import CheckpointManager, shape_mismatches
 from dotaclient_tpu.utils.metrics import MetricsLogger
 
@@ -181,6 +181,13 @@ class Learner:
         )
 
         reg = telemetry.get_registry()
+        # Pipeline tracing + device hooks (ISSUE 12): the tracer is
+        # captured ONCE (faults.get() discipline — configure before
+        # constructing the learner); the trace/compile/mem keys are
+        # eager-created so `check_telemetry_schema.py --require-trace`
+        # validates ANY learner JSONL deterministically.
+        tracing.ensure_metrics(reg)
+        self._tracer = tracing.get()
         reg.gauge("mesh/n_devices").set(float(self.mesh.devices.size))
         reg.gauge("mesh/data_shards").set(
             float(batch_shard_count(self.mesh, config.mesh))
@@ -353,9 +360,16 @@ class Learner:
             if config.ppo.anchor_kl_coef > 0
             else None
         )
-        self.train_step = make_train_step(
-            self.policy, config, self.mesh, debug_checkify=debug_checkify,
-            anchor_params=self.anchor_params,
+        # instrument_jit (ISSUE 12): per-program compile/retrace counters
+        # + cost analysis once per compile; transparent to dispatch and
+        # to the donation lint (lint/donation.py unwraps the call)
+        self.train_step = tracing.instrument_jit(
+            make_train_step(
+                self.policy, config, self.mesh,
+                debug_checkify=debug_checkify,
+                anchor_params=self.anchor_params,
+            ),
+            "train_step",
         )
         # Fused epoch step (ppo.fused_epoch): when one consumed batch needs
         # E×M > 1 optimizer steps, run them all in ONE donated program
@@ -369,9 +383,12 @@ class Learner:
             and mode != "fused"
             and not debug_checkify
         ):
-            self.epoch_step = make_epoch_step(
-                self.policy, config, self.mesh,
-                anchor_params=self.anchor_params,
+            self.epoch_step = tracing.instrument_jit(
+                make_epoch_step(
+                    self.policy, config, self.mesh,
+                    anchor_params=self.anchor_params,
+                ),
+                "epoch_step",
             )
         # Fused mode trains each chunk inside its one program and never
         # stages experience: allocating the HBM ring there would pin
@@ -428,7 +445,9 @@ class Learner:
                 ckpt=self.ckpt,
                 health=self._health,
             )
-            self._snap_copy = jax.jit(lambda t: jax.tree.map(jnp.copy, t))
+            self._snap_copy = tracing.instrument_jit(
+                jax.jit(lambda t: jax.tree.map(jnp.copy, t)), "snap_copy"
+            )
         # eager-create the stall gauges (and, sync mode, the snapshot keys
         # the engine would have created): a clean run reports zeros —
         # check_telemetry_schema.py --require-snapshot pins all four
@@ -460,9 +479,12 @@ class Learner:
             if mode == "fused":
                 from dotaclient_tpu.train.fused import make_fused_step
 
-                self.fused_step = make_fused_step(
-                    self.policy, config, self.mesh, self.device_actor,
-                    anchor_params=self.anchor_params,
+                self.fused_step = tracing.instrument_jit(
+                    make_fused_step(
+                        self.policy, config, self.mesh, self.device_actor,
+                        anchor_params=self.anchor_params,
+                    ),
+                    "fused_step",
                 )
         elif mode == "vec":
             self.pool = VecActorPool(
@@ -524,11 +546,14 @@ class Learner:
         # log/checkpoint gating.
         from dotaclient_tpu.parallel import data_sharding
 
-        self._minibatch_gather = jax.jit(
-            lambda batch, idx: jax.tree.map(lambda x: x[idx], batch),
-            # minibatches must arrive at the train step in its batch
-            # sharding (the donated step pins its in_shardings)
-            out_shardings=data_sharding(self.mesh, config.mesh),
+        self._minibatch_gather = tracing.instrument_jit(
+            jax.jit(
+                lambda batch, idx: jax.tree.map(lambda x: x[idx], batch),
+                # minibatches must arrive at the train step in its batch
+                # sharding (the donated step pins its in_shardings)
+                out_shardings=data_sharding(self.mesh, config.mesh),
+            ),
+            "minibatch_gather",
         )
         self._mb_rng = np.random.default_rng(config.seed + 1)
         self._mb_draws = 0          # permutations consumed (for exact resume)
@@ -667,6 +692,8 @@ class Learner:
             self._host_step += E * M
             self._host_version += E * M
             self._submit_health(m)
+            if self._tracer is not None:
+                self._emit_dispatch_traces()
             return m
         for _ in range(E):
             if M == 1:
@@ -690,7 +717,24 @@ class Learner:
                 self._host_step += 1
                 self._host_version += 1
         self._submit_health(m)
+        if self._tracer is not None:
+            self._emit_dispatch_traces()
         return m
+
+    def _emit_dispatch_traces(self) -> None:
+        """Terminal hop of the chunk timeline (ISSUE 12): the batch the
+        just-issued dispatch consumes carries the records its ``take``
+        parked in the buffer — stamp ``dispatch`` and emit them, plus the
+        sampled per-dispatch lifecycle event. Host dict appends only;
+        caller guards on ``self._tracer``."""
+        tracer = self._tracer
+        ts = tracing.now()
+        if self.buffer is not None:
+            for rec in self.buffer.drain_traces():
+                rec["hops"].append(["dispatch", ts])
+                tracer.emit_chunk(rec)
+        if tracer.should_sample():
+            tracer.emit("dispatch", step=self._host_step)
 
     def _next_batch(self, drain_transport: bool = True):
         """The consume side of the prefetch lane: hand back the batch
@@ -1047,12 +1091,20 @@ class Learner:
             if self._health is not None and self._health.unhealthy is not None:
                 self.telemetry.counter("health/publish_blocked_total").inc()
             else:
+                trace_blob = None
+                if self._tracer is not None:
+                    rec = tracing.weights_record(self._host_version)
+                    trace_blob = tracing.record_to_blob(rec, pad=False)
+                    self._tracer.emit(
+                        "publish", version=self._host_version
+                    )
                 with self.telemetry.span("transport/publish_weights"):
                     self.transport.publish_weights(
                         encode_weights(
                             self.state.params,   # one batched fetch inside
                             self._host_version,
                             wire_dtype=self.config.transport.wire_dtype,
+                            trace=trace_blob,
                         )
                     )
                 self._published_version = max(
@@ -1268,6 +1320,9 @@ class Learner:
             self.telemetry.gauge("learner/overlap_fraction").set(
                 self._prefetch_overlapped_s / staged
             )
+        # device-memory watermark (ISSUE 12): host-only allocator metadata,
+        # refreshed at log cadence; CPU backends report none → stays 0
+        tracing.update_memory_gauges(self.telemetry)
 
     def train(
         self,
@@ -1470,6 +1525,8 @@ class Learner:
                     da.env_steps += frames_per
                     da.rollouts_shipped += da.n_lanes * k_iters
                     self._submit_health(m)
+                    if self._tracer is not None:
+                        self._emit_dispatch_traces()
                     steps_done += stride
                     steps_done -= after_step(m, frames=frames_per)
             elif self.device_actor is not None:
@@ -1816,9 +1873,25 @@ def main(argv=None) -> Dict[str, float]:
         help="publish weights to actors every N optimizer steps",
     )
     p.add_argument(
-        "--profile", type=str, default=None,
-        help="capture a jax.profiler device trace of the run to this logdir "
-        "(view with tensorboard)",
+        "--profile", "--profile-dir", dest="profile", type=str, default=None,
+        metavar="DIR",
+        help="capture a jax.profiler device trace of the run to DIR "
+        "(utils/profiling.trace; view with tensorboard + "
+        "tensorboard-plugin-profile). --profile-dir is the long spelling",
+    )
+    p.add_argument(
+        "--trace-jsonl", type=str, default=None, metavar="PATH",
+        help="pipeline tracing (ISSUE 12): append sampled lifecycle "
+        "events (chunk hop timelines, publish/apply, per-compile cost "
+        "analysis, dispatches) as JSON lines to PATH; merge a "
+        "learner+actors run's logs with scripts/trace_report.py. Off by "
+        "default — the hot paths then pay one pointer test",
+    )
+    p.add_argument(
+        "--trace-sample", type=int, default=None, metavar="N",
+        help="with --trace-jsonl: trace every Nth sampling decision "
+        "(default telemetry.trace_sample_n = 16; 1 = every chunk, the "
+        "chaos-harness setting)",
     )
     p.add_argument(
         "--checkify", action="store_true",
@@ -1970,6 +2043,11 @@ def main(argv=None) -> Dict[str, float]:
             )
         )
 
+    # tracer BEFORE any pipeline object: pools/buffers/learner capture
+    # tracing.get() at construction (the faults.get() discipline)
+    if args.trace_jsonl:
+        tracing.configure(args.trace_jsonl, sample_n=args.trace_sample)
+
     transport = None
     if args.transport == "socket":
         from dotaclient_tpu.transport.socket_transport import TransportServer
@@ -2086,6 +2164,11 @@ def main(argv=None) -> Dict[str, float]:
                 )
         raise
     finally:
+        if args.trace_jsonl:
+            # drain + fsync the trace log (clean exits; a SIGKILL relies
+            # on the writer thread's per-batch flush and the torn-line-
+            # tolerant reader)
+            tracing.shutdown()
         if transport is not None and hasattr(transport, "close"):
             # deterministic teardown even when train() raises: the shm
             # server unlinks its segments (the resource tracker would

@@ -63,7 +63,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 
-from dotaclient_tpu.utils import telemetry
+from dotaclient_tpu.utils import telemetry, tracing
 
 logger = logging.getLogger(__name__)
 
@@ -92,6 +92,9 @@ class SnapshotEngine:
         # serving the last good version) and the periodic checkpoint (the
         # retention loop must not rotate good saves out for poisoned ones).
         self._health = health
+        # Pipeline tracing (ISSUE 12): captured once, like the learner's —
+        # with tracing off the publish path pays one pointer test
+        self._tracer = tracing.get()
         self._tel = registry if registry is not None else telemetry.get_registry()
         self._cond = threading.Condition()
         self._jobs: Dict[str, Optional[Tuple]] = {k: None for k in _KINDS}
@@ -307,7 +310,17 @@ class SnapshotEngine:
         from dotaclient_tpu.transport.serialize import encode_weights
 
         host = self._fetch(params)
-        msg = encode_weights(host, version, wire_dtype=self._wire_dtype)
+        trace_blob = None
+        if self._tracer is not None:
+            # publish-side trace record (ISSUE 12): stamped AFTER the
+            # fetch so the hop dates the moment the version hits the
+            # fanout, which is what actor-apply lag is measured against
+            rec = tracing.weights_record(version)
+            trace_blob = tracing.record_to_blob(rec, pad=False)
+            self._tracer.emit("publish", version=version)
+        msg = encode_weights(
+            host, version, wire_dtype=self._wire_dtype, trace=trace_blob
+        )
         with self._tel.span("transport/publish_weights"):
             self._transport.publish_weights(msg)
         self._last_published = version

@@ -195,16 +195,29 @@ def decode_drained_payloads(
     ``[wire_total, raw_total]`` pair (updated in place). Returns
     ``(decoded (meta, arrays) pairs, malformed-payload count)`` —
     malformed payloads (version-skewed actors, port scanners) are counted
-    and dropped, the disposable-actor failure model (SURVEY.md §5.3)."""
+    and dropped, the disposable-actor failure model (SURVEY.md §5.3).
+
+    Items may be bare payloads or ``(recv_ts, payload)`` pairs — both
+    transports ship the pair (ISSUE 12: the receive timestamp is the
+    ``recv`` trace hop; receive and CRC verify share it, both lanes
+    verify in the same pass). Trace stamping runs ONLY when this process
+    has a tracer configured — an untracing learner pays one pointer test
+    per drain."""
+    from dotaclient_tpu.utils import tracing
+
+    tracer = tracing.get()
     out = []
     bad = 0
     wire = raw = 0
-    for p in payloads:
+    for item in payloads:
+        recv_ts, p = item if isinstance(item, tuple) else (None, item)
         try:
             meta, arrays = decode_rollout_bytes(p)
         except Exception:
             bad += 1
             continue
+        if tracer is not None and "trace_blob" in meta:
+            tracing.stamp_wire_hops(meta, recv_ts)
         # actual bytes consumed vs what the same payloads would have cost
         # full-width — the decoder computed both from the in-band cast
         # marker (host ints only)
@@ -401,12 +414,16 @@ def encode_rollout(
     total_reward: float,
     wire_dtype: str = "float32",
     int_bounds: "Mapping[str, int] | None" = None,
+    trace: "bytes | None" = None,
 ) -> pb.Rollout:
     """Serialize one rollout's pytree of host arrays.
 
     ``wire_dtype="bfloat16"`` narrows the experience leaves per
     :func:`rollout_cast_plan` (pinned leaves stay byte-identical f32) and
-    records the casts in the in-band ``__wire_cast__`` marker entry."""
+    records the casts in the in-band ``__wire_cast__`` marker entry.
+    ``trace`` (ISSUE 12) is a pipeline-tracing record blob
+    (``utils/tracing.record_to_blob``) that rides as one more in-band
+    marker entry (``__trace__``) on sampled chunks."""
     r = pb.Rollout(
         model_version=model_version,
         env_id=env_id,
@@ -416,7 +433,11 @@ def encode_rollout(
     )
     flat = flatten_tree(arrays)
     flat, marker = _narrow_rollout_flat(flat, wire_dtype, int_bounds)
-    n_entries = len(flat) + (1 if marker is not None else 0)
+    n_entries = (
+        len(flat)
+        + (1 if marker is not None else 0)
+        + (1 if trace is not None else 0)
+    )
     if n_entries > _MAX_TENSORS:
         _raise_too_many_tensors(n_entries, "encode")
     for name, arr in flat.items():
@@ -424,6 +445,10 @@ def encode_rollout(
     if marker is not None:
         r.arrays[_WIRE_CAST_MARKER].CopyFrom(
             pb.TensorProto(shape=[len(marker)], dtype="marker", data=marker)
+        )
+    if trace is not None:
+        r.arrays[_TRACE_MARKER].CopyFrom(
+            pb.TensorProto(shape=[len(trace)], dtype="marker", data=trace)
         )
     return r
 
@@ -449,6 +474,9 @@ def decode_rollout(
     for name, t in r.arrays.items():
         if name == _WIRE_CAST_MARKER:
             cast = _parse_cast_marker(t.data)
+            continue
+        if name == _TRACE_MARKER:
+            meta["trace_blob"] = t.data
             continue
         flat[name] = proto_to_tensor(t)
     if cast:
@@ -572,6 +600,7 @@ def decode_rollout_bytes(
             if n >= 0:
                 flat = {}
                 cast: Dict[str, str] = {}
+                trace_blob: "bytes | None" = None
                 # one C-level conversion: rows become plain python tuples
                 for (
                     name_off, name_len, dtype_off, dtype_len,
@@ -581,6 +610,11 @@ def decode_rollout_bytes(
                     if name == _WIRE_CAST_MARKER:
                         cast = _parse_cast_marker(
                             bytes(payload[data_off:data_off + data_len])
+                        )
+                        continue
+                    if name == _TRACE_MARKER:
+                        trace_blob = bytes(
+                            payload[data_off:data_off + data_len]
                         )
                         continue
                     dkey = bytes(payload[dtype_off:dtype_off + dtype_len])
@@ -602,6 +636,8 @@ def decode_rollout_bytes(
                     "length": hdr.length,
                     "total_reward": hdr.total_reward,
                 }
+                if trace_blob is not None:
+                    meta["trace_blob"] = trace_blob
                 if cast:
                     # narrowed payloads carry their byte accounting; plain
                     # f32 frames keep the historical meta shape exactly
@@ -728,6 +764,7 @@ def encode_rollout_bytes(
     native: bool = True,
     wire_dtype: str = "float32",
     int_bounds: "Mapping[str, int] | None" = None,
+    trace: "bytes | None" = None,
 ) -> "bytes | memoryview":
     """Serialize one rollout straight to wire bytes (bytes-like).
 
@@ -768,7 +805,11 @@ def encode_rollout_bytes(
                 )
             flat = flatten_tree(arrays)
             flat, marker = _narrow_rollout_flat(flat, wire_dtype, int_bounds)
-            n_entries = len(flat) + (1 if marker is not None else 0)
+            n_entries = (
+                len(flat)
+                + (1 if marker is not None else 0)
+                + (1 if trace is not None else 0)
+            )
             if n_entries > _MAX_TENSORS:
                 _raise_too_many_tensors(n_entries, "encode")
             if all(a.ndim <= 8 for a in flat.values()):
@@ -781,6 +822,13 @@ def encode_rollout_bytes(
                     # the string only needs to match the proto path's)
                     names.append(_WIRE_CAST_MARKER)
                     arrs.append(np.frombuffer(marker, np.uint8))
+                    dnames.append("marker")
+                if trace is not None:
+                    # trace blobs are padded to tracing.TRACE_WIRE_LEN, so
+                    # the _SPEC_CACHE layout key below stays ONE key per
+                    # rollout structure, traced or not
+                    names.append(_TRACE_MARKER)
+                    arrs.append(np.frombuffer(trace, np.uint8))
                     dnames.append("marker")
                 n = len(names)
                 # Rollout structure is fixed across an actor's lifetime, so
@@ -842,7 +890,7 @@ def encode_rollout_bytes(
                     return out[:written].data
     return encode_rollout(
         arrays, model_version, env_id, rollout_id, length, total_reward,
-        wire_dtype=wire_dtype, int_bounds=int_bounds,
+        wire_dtype=wire_dtype, int_bounds=int_bounds, trace=trace,
     ).SerializeToString()
 
 
@@ -859,9 +907,28 @@ def encode_rollout_bytes(
 # obs/actions/carry0 or are known scalar-track names).
 _WIRE_CAST_MARKER = "__wire_cast__"
 
+# Pipeline-tracing marker (ISSUE 12): the same in-band pseudo-entry
+# discipline carries a compact trace record (utils/tracing.py blob —
+# origin pid/actor, trace id, weights version at collect, hop
+# timestamps) on sampled rollout chunks, every weights-publish frame a
+# tracing learner emits, and serve request/reply frames. Decode
+# intercepts it by name into ``meta["trace_blob"]`` (rollouts) or via
+# :func:`weights_trace` (weights) — it is never a data leaf.
+_TRACE_MARKER = "__trace__"
+
+
+def weights_trace(msg: pb.ModelWeights) -> "bytes | None":
+    """The raw trace blob a tracing learner attached to this weights
+    frame (None when absent). ``in`` before indexing: protobuf map
+    ``__getitem__`` auto-inserts."""
+    if _TRACE_MARKER in msg.params:
+        return msg.params[_TRACE_MARKER].data
+    return None
+
 
 def encode_weights(
-    params: Any, version: int, wire_dtype: str = "float32"
+    params: Any, version: int, wire_dtype: str = "float32",
+    trace: "bytes | None" = None,
 ) -> pb.ModelWeights:
     """Serialize a param pytree for the weights fanout.
 
@@ -901,6 +968,13 @@ def encode_weights(
         msg.params[_WIRE_CAST_MARKER].CopyFrom(
             pb.TensorProto(dtype="marker", data="\n".join(cast_names).encode())
         )
+    if trace is not None:
+        # publish-side trace record (ISSUE 12): origin pid + publish hop,
+        # so the actor's apply event can attribute fanout latency without
+        # any clock handshake beyond the shared epoch alignment
+        msg.params[_TRACE_MARKER].CopyFrom(
+            pb.TensorProto(dtype="marker", data=trace)
+        )
     return msg
 
 
@@ -921,7 +995,7 @@ def decode_weights(msg: pb.ModelWeights, upcast: bool = True) -> Tuple[int, Any]
         )
     flat = {}
     for name, t in msg.params.items():
-        if name == _WIRE_CAST_MARKER:
+        if name in (_WIRE_CAST_MARKER, _TRACE_MARKER):
             continue
         arr = proto_to_tensor(t)
         if (

@@ -70,7 +70,7 @@ from typing import List, Optional, Tuple
 
 from dotaclient_tpu.protos import dota_pb2 as pb
 from dotaclient_tpu.transport.serialize import frame_crc32
-from dotaclient_tpu.utils import faults, telemetry
+from dotaclient_tpu.utils import faults, telemetry, tracing
 
 _U64 = struct.Struct("<Q")
 _I64 = struct.Struct("<q")
@@ -426,21 +426,28 @@ class ShmTransportServer:
 
     def _drain(
         self, max_count: int, timeout: Optional[float]
-    ) -> List[memoryview]:
-        out: List[memoryview] = []
+    ) -> "List[Tuple[float, memoryview]]":
+        """Drain complete frames as ``(recv_ts, view)`` pairs. On the shm
+        lane the drain IS the receive (there is no reader thread), so one
+        stamp per drain call serves every frame it collected — the `recv`
+        trace hop (ISSUE 12), taken after the CRC folds like the socket
+        reader's."""
+        views: List[memoryview] = []
         t0 = time.perf_counter()
         deadline = None if timeout is None else t0 + timeout
         self._release_pending()
         while True:
             start = self._next_ring
             for k in range(self.slots):
-                self._drain_ring((start + k) % self.slots, max_count, out)
+                self._drain_ring((start + k) % self.slots, max_count, views)
             self._next_ring = (start + 1) % self.slots
-            if out or self._closed:
+            if views or self._closed:
                 break
             if deadline is not None and time.perf_counter() >= deadline:
                 break
             time.sleep(0.0005)
+        recv_ts = tracing.now()
+        out = [(recv_ts, v) for v in views]
         if out:
             self._tel.timer("span/transport/consume").observe(
                 time.perf_counter() - t0
@@ -514,7 +521,7 @@ class ShmTransportServer:
         self, max_count: int, timeout: Optional[float] = None
     ) -> List[pb.Rollout]:
         protos = []
-        for payload in self._drain(max_count, timeout):
+        for _recv_ts, payload in self._drain(max_count, timeout):
             r = pb.Rollout()
             try:
                 r.ParseFromString(payload)

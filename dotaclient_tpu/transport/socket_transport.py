@@ -78,7 +78,7 @@ from typing import Deque, List, Optional, Tuple
 
 from dotaclient_tpu.protos import dota_pb2 as pb
 from dotaclient_tpu.transport.serialize import frame_crc32
-from dotaclient_tpu.utils import faults, telemetry
+from dotaclient_tpu.utils import faults, telemetry, tracing
 
 _KIND_ROLLOUT = 0
 _KIND_WEIGHTS = 1
@@ -408,8 +408,14 @@ class TransportServer:
             self._drop(conn)
 
     def _enqueue_rollouts(self, frames: List[bytes]) -> None:
+        # one receive stamp per parse batch (ISSUE 12): these frames were
+        # CRC-verified in the same wakeup, so the stamp is the `recv` trace
+        # hop for every traced chunk in the batch — the queue holds
+        # (recv_ts, payload) pairs and the cost is one clock read per
+        # wakeup whether tracing is on or off
+        ts = tracing.now()
         with self._roll_cond:
-            self._rollouts.extend(frames)
+            self._rollouts.extend((ts, f) for f in frames)
             over = len(self._rollouts) - self._max_rollouts
             if over > 0:  # drop-oldest backpressure
                 for _ in range(over):
@@ -510,10 +516,14 @@ class TransportServer:
     def publish_rollout(self, rollout: pb.Rollout) -> None:
         raise RuntimeError("TransportServer is the learner side; actors publish")
 
-    def _drain(self, max_count: int, timeout: Optional[float]) -> List[bytes]:
+    def _drain(
+        self, max_count: int, timeout: Optional[float]
+    ) -> List[Tuple[float, bytes]]:
         # timed explicitly, recorded only when something drained: empty poll
-        # timeouts measure idle waiting, not drain cost (see queues.py)
-        out: List[bytes] = []
+        # timeouts measure idle waiting, not drain cost (see queues.py).
+        # Items are (recv_ts, payload) pairs — recv_ts is the reader
+        # thread's post-CRC arrival stamp (the `recv` trace hop).
+        out: List[Tuple[float, bytes]] = []
         t0 = time.perf_counter()
         deadline = None if timeout is None else t0 + timeout
         with self._roll_cond:
@@ -545,7 +555,7 @@ class TransportServer:
         self, max_count: int, timeout: Optional[float] = None
     ) -> List[pb.Rollout]:
         protos = []
-        for payload in self._drain(max_count, timeout):
+        for _recv_ts, payload in self._drain(max_count, timeout):
             r = pb.Rollout()
             try:
                 r.ParseFromString(payload)

@@ -12,11 +12,19 @@ scalars only; the rebuild's observability is two complementary layers:
   (tensorboard-plugin-profile) and `checkify`-instrumented train steps for
   NaN/Inf hunting. This layer answers *why* a device stage is slow.
 
+A third layer joined in ISSUE 12: the pipeline TRACING plane
+(``utils/tracing.py``, ``--trace-jsonl``) follows individual chunks and
+weight versions ACROSS processes (hop timelines, critical-path and
+staleness attribution via ``scripts/trace_report.py``) and wraps the jit
+entry points with compile/retrace accounting. Spans say which stage,
+tracing says which hop of which chunk, this module's profiler says why
+the device program itself is slow.
+
 Usage:
     with trace("runs/profile"):           # device trace of the block
         learner.train(100)
 
-    python -m dotaclient_tpu.train.learner --profile runs/profile
+    python -m dotaclient_tpu.train.learner --profile-dir runs/profile
     python -m dotaclient_tpu.train.learner --checkify   # debug numerics
     python -m dotaclient_tpu.train.learner --metrics-jsonl run.jsonl  # spans
 """

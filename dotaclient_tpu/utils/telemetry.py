@@ -78,6 +78,7 @@ from __future__ import annotations
 import contextlib
 import json
 import math
+import os
 import threading
 import time
 from typing import Dict, Iterator, List, Optional, TextIO
@@ -91,7 +92,18 @@ __all__ = [
     "JsonlSink",
     "TensorBoardSink",
     "get_registry",
+    "load_jsonl",
+    "trace_sample_n",
 ]
+
+# Pipeline-tracing sample cadence (ISSUE 12; utils/tracing.py): with a
+# trace log configured (``--trace-jsonl``), every Nth sampling decision —
+# chunk encode, train dispatch, serve request — carries/emits a trace
+# record; the rest pay one int test. 1 = trace everything (chaos runs,
+# latency hunts); with tracing OFF the knob is never consulted at all
+# (``tracing.get() is None`` is the whole hot-path cost). ``--trace-sample``
+# overrides per process.
+trace_sample_n = 16
 
 
 class Counter:
@@ -320,11 +332,18 @@ def _json_safe(v: float) -> Optional[float]:
 class JsonlSink:
     """Append one JSON object per emit: ``{"ts": <unix>, "step": <int>,
     "scalars": {name: number|null}}`` — the machine-readable record for
-    headless/bench runs (non-finite values become null)."""
+    headless/bench runs (non-finite values become null).
+
+    Durability (ISSUE 12): the stream is line-buffered and every emit is
+    ONE ``write`` of a complete line followed by a flush, so a SIGKILL'd
+    process (the chaos harness's stock in trade) can tear at most the
+    line the OS was mid-writing — never interleave two lines; ``close``
+    fsyncs before closing. Readers go through :func:`load_jsonl`, which
+    drops an unterminated trailing line instead of choking on it."""
 
     def __init__(self, path: str) -> None:
         self.path = path
-        self._f: Optional[TextIO] = open(path, "a")
+        self._f: Optional[TextIO] = open(path, "a", buffering=1)
         self._lock = threading.Lock()
 
     def emit(self, step: int, scalars: Dict[str, float]) -> None:
@@ -345,8 +364,33 @@ class JsonlSink:
     def close(self) -> None:
         with self._lock:
             if self._f is not None:
+                try:
+                    self._f.flush()
+                    os.fsync(self._f.fileno())
+                except (OSError, ValueError):
+                    pass  # durability is best-effort; close must not raise
                 self._f.close()
                 self._f = None
+
+
+def load_jsonl(path: str) -> List[str]:
+    """Read a JSONL file's COMPLETE lines, tolerating the one torn
+    trailing line a SIGKILL can leave (no terminating newline → the
+    write was cut mid-line → the line is dropped, never parsed). The
+    shared reader for ``scripts/trace_report.py`` and
+    ``scripts/check_telemetry_schema.py`` — both must survive a chaos
+    harness's corpses (ISSUE 12)."""
+    with open(path, "r") as f:
+        text = f.read()
+    if not text:
+        return []
+    complete = text.endswith("\n")
+    lines = text.split("\n")
+    if lines and lines[-1] == "":
+        lines.pop()
+    if lines and not complete:
+        lines.pop()  # torn trailing line: mid-write at kill time
+    return lines
 
 
 class TensorBoardSink:

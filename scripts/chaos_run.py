@@ -130,6 +130,11 @@ class Supervisor:
             "--buffer", "capacity_rollouts=64,min_fill=8",
             "--refresh-every", "2",
             "--on-crash-checkpoint",
+            # pipeline tracing at every-chunk cadence (ISSUE 12): the
+            # merged trace must survive this harness's kills/restarts
+            "--trace-jsonl",
+            os.path.join(self.workdir, f"learner{phase}.trace.jsonl"),
+            "--trace-sample", "1",
         ]
         cmd += extra or []
         if restore:
@@ -165,6 +170,13 @@ class Supervisor:
                 "--rollout-len", "8",
                 "--seed", str(i),
                 "--max-reconnects", "10",
+                # every restarted incarnation APPENDS to the same trace
+                # log — events carry the incarnation's pid, and a SIGKILL
+                # mid-line is exactly what the torn-line-tolerant reader
+                # exists for (ISSUE 12)
+                "--trace-jsonl",
+                os.path.join(self.workdir, f"actor{i}.trace.jsonl"),
+                "--trace-sample", "1",
             ],
             cwd=REPO, env=env, stdout=log, stderr=subprocess.STDOUT,
         )
@@ -249,6 +261,7 @@ class Supervisor:
         if victim is not None and victim.poll() is None:
             victim.kill()   # -9: no cleanup, the hard-failure shape
             self.actor_kills += 1
+            summary["killed_actor_pid"] = victim.pid
         summary["actor_kills"] = self.actor_kills
 
         # 2) at the sigterm threshold, graceful-stop the learner mid-run
@@ -289,6 +302,32 @@ class Supervisor:
                 ) or 0.0,
             )
         summary["frames_corrupt_total"] = corrupt
+
+        # 5) merged pipeline trace (ISSUE 12): the kill/restart chaos must
+        # not break the trace plane — the killed actor's shipped chunks
+        # still resolve in the merged report (its torn log reads cleanly)
+        # and its restarted incarnation traces under a FRESH origin pid.
+        victim_pid = summary.get("killed_actor_pid")
+        trace_report = None
+        incarnation_pids: List[int] = []
+        try:
+            from scripts.trace_report import build_report, load_events
+
+            trace_report = build_report([self.workdir])
+            victim_log = os.path.join(
+                self.workdir, f"actor{a.actors - 1}.trace.jsonl"
+            )
+            events, _skipped = load_events([victim_log])
+            incarnation_pids = sorted(
+                {ev.get("pid") for ev in events if ev.get("pid")}
+            )
+        except Exception as e:  # noqa: BLE001 - reported as a failure below
+            summary["trace_error"] = f"{type(e).__name__}: {e}"
+        if trace_report is not None:
+            summary["trace_chunks_complete"] = trace_report["chunks_complete"]
+            summary["trace_origin_pids"] = trace_report["origin_pids"]
+            summary["trace_incarnation_pids"] = incarnation_pids
+
         if rc2 != 0:
             summary["fail"] = "restored learner did not complete cleanly"
         elif final != saved + a.resume_steps:
@@ -304,6 +343,26 @@ class Supervisor:
             )
         elif self.actor_kills < 1:
             summary["fail"] = "no actor was killed — schedule never ran"
+        elif trace_report is None:
+            summary["fail"] = (
+                "merged trace report failed to build: "
+                + summary.get("trace_error", "unknown")
+            )
+        elif trace_report["chunks_complete"] < 1:
+            summary["fail"] = (
+                "no complete chunk trace survived the run — the trace "
+                "plane lost the pipeline"
+            )
+        elif victim_pid not in trace_report["origin_pids"]:
+            summary["fail"] = (
+                f"the killed actor's (pid {victim_pid}) shipped chunks do "
+                f"not resolve in the merged trace report"
+            )
+        elif victim_pid not in incarnation_pids or len(incarnation_pids) < 2:
+            summary["fail"] = (
+                f"the restarted actor did not trace under a fresh origin "
+                f"pid (incarnations seen: {incarnation_pids})"
+            )
         return summary
 
     def run_divergence(self) -> Dict:

@@ -35,6 +35,35 @@ import sys
 import tempfile
 from typing import Dict, List, Optional
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # direct `python scripts/...` invocation
+    sys.path.insert(0, _REPO)
+
+
+def _light_load_jsonl():
+    """The torn-line-tolerant reader (ISSUE 12: a SIGKILL'd process can
+    leave one unterminated trailing line; validation drops it instead of
+    failing) WITHOUT the dotaclient_tpu package import chain —
+    utils/__init__ pulls jax + orbax, a multi-second cost the pure
+    `--path` validation flow must not pay. Reuse the already-imported
+    module when a host process (tests, the smoke runner) loaded it;
+    otherwise exec telemetry.py (stdlib-only) straight from its file.
+    Shared semantics with scripts/trace_report.py."""
+    mod = sys.modules.get("dotaclient_tpu.utils.telemetry")
+    if mod is None:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "_dota_telemetry_light",
+            os.path.join(_REPO, "dotaclient_tpu", "utils", "telemetry.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    return mod.load_jsonl
+
+
+load_jsonl = _light_load_jsonl()
+
 # Every key a --smoke run (device actor, in-proc transport, HBM buffer) must
 # emit. Timer stats are spot-checked through their /mean_s leaf; the other
 # leaves (count/total_s/last_s/ema_s/p95_s) share the emission path.
@@ -169,6 +198,22 @@ SERVE_KEYS = (
     "serve/clients_connected",     # attached games
     "serve/slots_in_use",          # carry slots owned by live games
     "serve/conns_rejected_total",  # joiners shed with every slot taken
+)
+
+# Pipeline tracing + device observability (ISSUE 12). Validated with
+# --require-trace against ANY learner run's JSONL: the Learner
+# eager-creates all six at construction (tracing.ensure_metrics) — the
+# trace emit/drop counters stay 0 with tracing off, the compile counters
+# track the instrumented jit entry points regardless of tracing, and
+# mem/hbm_peak_bytes degrades to 0 on backends without allocator stats
+# (CPU).
+TRACE_KEYS = (
+    "trace/emitted_total",          # trace events written to --trace-jsonl
+    "trace/dropped_total",          # events dropped (writer behind / queue full)
+    "compile/compiles_total",       # XLA compiles across instrumented programs
+    "compile/retraces_total",       # compiles beyond each program's first
+    "compile/compile_time_s_total", # cumulative seconds spent compiling
+    "mem/hbm_peak_bytes",           # device allocator peak (max over devices)
 )
 
 # Keys only an IN-PROCESS actor emits. A learner serving external actor
@@ -310,6 +355,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         "construction",
     )
     p.add_argument(
+        "--require-trace", action="store_true",
+        help="also require the pipeline-tracing + device-observability "
+        "keys (ISSUE 12); valid against ANY learner run's JSONL — the "
+        "Learner eager-creates trace/compile/mem keys at construction",
+    )
+    p.add_argument(
         "--require-multichip", action="store_true",
         help="also require the multi-chip learner keys (ISSUE 10); valid "
         "against ANY learner run's JSONL at any device count — the "
@@ -334,6 +385,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         extra += SERVE_KEYS
     if args.require_multichip:
         extra += MULTICHIP_KEYS
+    if args.require_trace:
+        extra += TRACE_KEYS
 
     path = args.path
     if path is None:
@@ -341,13 +394,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         os.close(fd)
         try:
             run_smoke(path)
-            with open(path) as f:
-                lines = f.read().splitlines()
+            lines = load_jsonl(path)
         finally:
             os.unlink(path)
     else:
-        with open(path) as f:
-            lines = f.read().splitlines()
+        lines = load_jsonl(path)
 
     # a serve run is a different process class: its JSONL carries the
     # serve-plane keys, not the learner pipeline's actor/buffer spans

@@ -1,0 +1,427 @@
+"""Merge per-process trace logs into an end-to-end pipeline report.
+
+Each process of a run (learner ``--trace-jsonl``, actors ``--trace-jsonl``,
+serve ``--trace-jsonl``) appends sampled lifecycle events to its own JSONL
+trace log (``utils/tracing.py``). This script joins them:
+
+* **per-chunk end-to-end latency histogram** — actor chunk collection →
+  train dispatch, from the merged hop timelines (chunks are keyed by
+  trace id; the learner's record carries the full timeline, the actor's
+  partial record survives even a SIGKILLed actor);
+* **critical-path breakdown** — mean/p50/p95 of every adjacent hop delta
+  (actor compute, wire, drain wait, admission, ring residency, dispatch
+  wait) plus its share of the mean end-to-end latency — the table that
+  names the slow hop when the pipeline regresses;
+* **weight-staleness attribution** — for every traced chunk, how old its
+  collection weights were at dispatch, decomposed into publish→apply
+  (fanout latency), apply→encode (actor hold), and encode→dispatch
+  (pipeline transit) — the table that says WHICH hop ages the weights
+  (IMPACT's first-class quantity, PAPERS.md);
+* **serve round trips** and **compile events** when present.
+
+Timestamps are epoch-aligned monotonic (one clock per host modulo the
+capture jitter; cross-host joins inherit NTP error — see
+docs/ARCHITECTURE.md "Pipeline tracing"). Reading is torn-line tolerant
+(``telemetry.load_jsonl`` + per-line skip): a SIGKILLed actor's log — the
+chaos harness's standard corpse — merges cleanly.
+
+Usage:
+    python scripts/trace_report.py RUN_DIR              # every *.jsonl in it
+    python scripts/trace_report.py a.jsonl b.jsonl ...  # explicit logs
+    python scripts/trace_report.py --json RUN_DIR       # summary line only
+
+Exit 0 with a ``TRACE_REPORT {json}`` summary line; exit 1 when no trace
+events were found at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _light_load_jsonl():
+    """The torn-line-tolerant reader WITHOUT the dotaclient_tpu package
+    import chain (utils/__init__ pulls jax + orbax — multi-second, and a
+    hard dependency this text-file reader does not have). Reuse the
+    already-imported module when a host process loaded it; otherwise
+    exec telemetry.py (stdlib-only) straight from its file."""
+    mod = sys.modules.get("dotaclient_tpu.utils.telemetry")
+    if mod is None:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "_dota_telemetry_light",
+            os.path.join(REPO, "dotaclient_tpu", "utils", "telemetry.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    return mod.load_jsonl
+
+
+load_jsonl = _light_load_jsonl()
+
+# canonical hop order of the experience pipeline; adjacent deltas are the
+# critical-path segments (docs/ARCHITECTURE.md "Pipeline tracing")
+PIPELINE_HOPS = (
+    "collect", "encode", "recv", "consume", "admit", "gather", "dispatch",
+)
+SEGMENT_LABELS = {
+    ("collect", "encode"): "actor compute",
+    ("encode", "recv"): "wire",
+    ("recv", "consume"): "drain wait",
+    ("consume", "admit"): "admission",
+    ("admit", "gather"): "ring residency",
+    ("gather", "dispatch"): "dispatch wait",
+}
+SERVE_HOPS = ("encode", "recv", "reply", "done")
+
+
+def load_events(paths: List[str]) -> Tuple[List[dict], int]:
+    """All trace events from ``paths`` (files or directories; directories
+    contribute every ``*.jsonl`` inside). Lines that are not parseable
+    trace events — torn tails, metrics-JSONL lines sharing a directory —
+    are skipped and counted."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(glob.glob(os.path.join(p, "*.jsonl"))))
+        else:
+            files.append(p)
+    events: List[dict] = []
+    skipped = 0
+    for path in files:
+        try:
+            lines = load_jsonl(path)
+        except OSError:
+            skipped += 1
+            continue
+        for line in lines:
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                skipped += 1
+                continue
+            if isinstance(obj, dict) and "event" in obj:
+                events.append(obj)
+            else:
+                skipped += 1  # a metrics line, not a trace event
+    return events, skipped
+
+
+def merge_chunks(events: List[dict]) -> Dict[str, dict]:
+    """tid → merged ROLLOUT chunk record. Multiple processes emit the
+    same tid (actor partial at ship, learner complete at dispatch); hops
+    union by name, first timestamp wins (they describe the same
+    instant). Serve round-trip records (their hop set contains
+    ``reply``/``done``) are EXCLUDED — they also carry encode/recv hops
+    and would otherwise contaminate the experience pipeline's "wire"
+    segment and chunk counts; :func:`serve_rtts` reports them."""
+    chunks: Dict[str, dict] = {}
+    for ev in events:
+        if ev.get("event") != "chunk":
+            continue
+        tid = ev.get("tid")
+        if not tid:
+            continue
+        hop_names = {h[0] for h in ev.get("hops", ()) if h}
+        if hop_names & {"reply", "done"}:
+            continue  # serve record: reported by serve_rtts, not here
+        rec = chunks.setdefault(
+            tid,
+            {
+                "tid": tid,
+                "origin_pid": ev.get("origin_pid"),
+                "actor": ev.get("actor"),
+                "wv": ev.get("wv"),
+                "hops": {},
+            },
+        )
+        for name, ts in ev.get("hops", ()):
+            rec["hops"].setdefault(name, ts)
+    return chunks
+
+
+def _quantiles(values: List[float]) -> Dict[str, float]:
+    if not values:
+        return {"mean": 0.0, "p50": 0.0, "p95": 0.0, "n": 0}
+    s = sorted(values)
+    return {
+        "mean": sum(s) / len(s),
+        "p50": s[len(s) // 2],
+        "p95": s[min(len(s) - 1, int(math.ceil(0.95 * len(s))) - 1)],
+        "n": len(s),
+    }
+
+
+def critical_path(chunks: Dict[str, dict]) -> Dict[str, dict]:
+    """Adjacent-hop delta statistics over every chunk that has both ends
+    of a segment."""
+    out: Dict[str, dict] = {}
+    for a, b in zip(PIPELINE_HOPS, PIPELINE_HOPS[1:]):
+        deltas = [
+            rec["hops"][b] - rec["hops"][a]
+            for rec in chunks.values()
+            if a in rec["hops"] and b in rec["hops"]
+        ]
+        if deltas:
+            out[SEGMENT_LABELS[(a, b)]] = {
+                "from": a, "to": b, **_quantiles(deltas),
+            }
+    return out
+
+
+def e2e_histogram(
+    chunks: Dict[str, dict],
+) -> Tuple[List[float], Dict[str, float], List[Tuple[str, int]]]:
+    """(per-chunk end-to-end seconds, summary stats, pow2-ms buckets)."""
+    lat: List[float] = []
+    for rec in chunks.values():
+        hops = rec["hops"]
+        start = hops.get("collect", hops.get("encode"))
+        end = hops.get("dispatch")
+        if start is not None and end is not None and end >= start:
+            lat.append(end - start)
+    buckets: Dict[int, int] = {}
+    for v in lat:
+        b = max(0, int(math.log2(max(v * 1e3, 1e-3))) + 1)
+        buckets[b] = buckets.get(b, 0) + 1
+    rows = [
+        (f"< {2 ** b} ms", buckets[b]) for b in sorted(buckets)
+    ]
+    return lat, _quantiles(lat), rows
+
+
+def staleness_attribution(
+    chunks: Dict[str, dict], events: List[dict]
+) -> dict:
+    """Decompose each chunk's weights age at dispatch.
+
+    ``publish`` events date version V's fanout enqueue; ``apply`` events
+    date (pid, V) applying it (falling back to the in-band publish_ts
+    they echo when the learner's own log is absent). Components:
+    publish→apply = fanout latency, apply→encode = actor hold,
+    encode→dispatch = pipeline transit. The dominant component is the
+    hop that ages the weights."""
+    publishes: Dict[int, float] = {}
+    applies: Dict[Tuple[int, int], float] = {}
+    for ev in events:
+        if ev.get("event") == "publish" and "version" in ev:
+            publishes.setdefault(int(ev["version"]), ev.get("ts", 0.0))
+            continue
+        if ev.get("event") == "apply" and "version" in ev:
+            applies.setdefault(
+                (ev.get("pid"), int(ev["version"])), ev.get("ts", 0.0)
+            )
+            if ev.get("publish_ts") is not None:
+                publishes.setdefault(
+                    int(ev["version"]), float(ev["publish_ts"])
+                )
+    fanout: List[float] = []
+    hold: List[float] = []
+    transit: List[float] = []
+    total: List[float] = []
+    for rec in chunks.values():
+        hops = rec["hops"]
+        wv = rec.get("wv")
+        encode = hops.get("encode")
+        dispatch = hops.get("dispatch")
+        if wv is None or encode is None or dispatch is None:
+            continue
+        pub = publishes.get(int(wv))
+        app = applies.get((rec.get("origin_pid"), int(wv)))
+        if app is not None and encode >= app:
+            hold.append(encode - app)
+            if pub is not None and app >= pub:
+                fanout.append(app - pub)
+        transit.append(dispatch - encode)
+        if pub is not None and dispatch >= pub:
+            total.append(dispatch - pub)
+    components = {
+        "publish→apply (fanout)": _quantiles(fanout),
+        "apply→encode (actor hold)": _quantiles(hold),
+        "encode→dispatch (pipeline)": _quantiles(transit),
+    }
+    measured = {k: v for k, v in components.items() if v["n"]}
+    dominant = (
+        max(measured, key=lambda k: measured[k]["mean"]) if measured else None
+    )
+    return {
+        "components": components,
+        "weights_age_at_dispatch_s": _quantiles(total),
+        "dominant": dominant,
+        "publishes_seen": len(publishes),
+        "applies_seen": len(applies),
+    }
+
+
+def serve_rtts(events: List[dict]) -> dict:
+    """Serve round trips from merged request records (hops
+    encode→recv→reply→done)."""
+    rtts = []
+    server_side = []
+    for ev in events:
+        if ev.get("event") != "chunk":
+            continue
+        hops = dict(ev.get("hops", ()))
+        if "done" in hops and "encode" in hops:
+            rtts.append(hops["done"] - hops["encode"])
+            if "reply" in hops and "recv" in hops:
+                server_side.append(hops["reply"] - hops["recv"])
+    return {"rtt_s": _quantiles(rtts), "server_s": _quantiles(server_side)}
+
+
+def compile_summary(events: List[dict]) -> dict:
+    progs: Dict[str, dict] = {}
+    for ev in events:
+        if ev.get("event") != "compile":
+            continue
+        p = progs.setdefault(
+            ev.get("program", "?"),
+            {"compiles": 0, "total_s": 0.0, "flops": 0.0, "bytes": 0.0},
+        )
+        p["compiles"] += 1
+        p["total_s"] += float(ev.get("elapsed_s", 0.0))
+        p["flops"] = max(p["flops"], float(ev.get("flops", 0.0)))
+        p["bytes"] = max(p["bytes"], float(ev.get("bytes_accessed", 0.0)))
+    return progs
+
+
+def build_report(paths: List[str]) -> dict:
+    events, skipped = load_events(paths)
+    chunks = merge_chunks(events)
+    complete = {
+        tid: rec for tid, rec in chunks.items() if "dispatch" in rec["hops"]
+    }
+    _lat, e2e, hist_rows = e2e_histogram(chunks)
+    return {
+        "events": len(events),
+        "lines_skipped": skipped,
+        "chunks_seen": len(
+            [r for r in chunks.values() if "collect" in r["hops"]
+             or "encode" in r["hops"]]
+        ),
+        "chunks_complete": len(complete),
+        "origin_pids": sorted(
+            {
+                rec["origin_pid"]
+                for rec in chunks.values()
+                if rec.get("origin_pid") is not None
+            }
+        ),
+        "e2e_latency_s": e2e,
+        "e2e_histogram": hist_rows,
+        "critical_path": critical_path(chunks),
+        "staleness": staleness_attribution(chunks, events),
+        "serve": serve_rtts(events),
+        "compiles": compile_summary(events),
+    }
+
+
+def _fmt_ms(v: float) -> str:
+    return f"{v * 1e3:9.2f}"
+
+
+def print_report(report: dict) -> None:
+    print(
+        f"trace report: {report['events']} events, "
+        f"{report['chunks_seen']} traced chunks "
+        f"({report['chunks_complete']} complete), origins "
+        f"{report['origin_pids']}, {report['lines_skipped']} line(s) skipped"
+    )
+    e2e = report["e2e_latency_s"]
+    if e2e["n"]:
+        print(
+            f"\nend-to-end chunk latency (collect→dispatch, n={e2e['n']}): "
+            f"mean {_fmt_ms(e2e['mean'])} ms  p50 {_fmt_ms(e2e['p50'])} ms  "
+            f"p95 {_fmt_ms(e2e['p95'])} ms"
+        )
+        width = max((n for _, n in report["e2e_histogram"]), default=1)
+        for label, n in report["e2e_histogram"]:
+            bar = "#" * max(1, int(40 * n / width))
+            print(f"  {label:>12} | {n:6d} {bar}")
+    cp = report["critical_path"]
+    if cp:
+        total_mean = sum(seg["mean"] for seg in cp.values()) or 1.0
+        print("\ncritical path (adjacent hop deltas):")
+        print(
+            f"  {'segment':<16} {'mean ms':>9} {'p50 ms':>9} "
+            f"{'p95 ms':>9} {'share':>7} {'n':>6}"
+        )
+        for label, seg in cp.items():
+            print(
+                f"  {label:<16} {_fmt_ms(seg['mean'])} {_fmt_ms(seg['p50'])} "
+                f"{_fmt_ms(seg['p95'])} {seg['mean'] / total_mean:6.1%} "
+                f"{seg['n']:6d}"
+            )
+    st = report["staleness"]
+    age = st["weights_age_at_dispatch_s"]
+    if any(v["n"] for v in st["components"].values()) or age["n"]:
+        print(
+            f"\nweight-staleness attribution "
+            f"(publishes seen: {st['publishes_seen']}, applies seen: "
+            f"{st['applies_seen']}):"
+        )
+        print(
+            f"  {'component':<28} {'mean ms':>9} {'p95 ms':>9} {'n':>6}"
+        )
+        for label, q in st["components"].items():
+            print(
+                f"  {label:<28} {_fmt_ms(q['mean'])} {_fmt_ms(q['p95'])} "
+                f"{q['n']:6d}"
+            )
+        if age["n"]:
+            print(
+                f"  weights age at dispatch: mean {_fmt_ms(age['mean'])} ms, "
+                f"p95 {_fmt_ms(age['p95'])} ms (n={age['n']})"
+            )
+        if st["dominant"]:
+            print(f"  dominant aging hop: {st['dominant']}")
+    serve = report["serve"]
+    if serve["rtt_s"]["n"]:
+        r, s = serve["rtt_s"], serve["server_s"]
+        print(
+            f"\nserve round trips (n={r['n']}): mean {_fmt_ms(r['mean'])} ms "
+            f"p99-ish p95 {_fmt_ms(r['p95'])} ms; server-side "
+            f"recv→reply mean {_fmt_ms(s['mean'])} ms"
+        )
+    if report["compiles"]:
+        print("\ncompiles (once-per-compile cost analysis):")
+        for prog, p in sorted(report["compiles"].items()):
+            print(
+                f"  {prog:<20} x{p['compiles']} "
+                f"{p['total_s']:8.2f}s total, "
+                f"{p['flops']:.3e} flops, {p['bytes']:.3e} bytes"
+            )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument(
+        "paths", nargs="+",
+        help="trace JSONL files and/or directories (directories "
+        "contribute every *.jsonl inside)",
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="print only the machine-readable TRACE_REPORT summary line",
+    )
+    args = p.parse_args(argv)
+    report = build_report(args.paths)
+    if not args.json:
+        print_report(report)
+    print("TRACE_REPORT " + json.dumps(report, sort_keys=True), flush=True)
+    return 0 if report["events"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -278,6 +278,35 @@ class TestUseAfterDonate:
         assert registry.get("make_train_step") == (0,)
         assert registry.get("make_epoch_step") == (0,)
 
+    def test_instrument_jit_wrapper_is_transparent(self):
+        """ISSUE 12: wrapping a donating jit (or factory call) in
+        ``tracing.instrument_jit(...)`` must NOT drop its taint tracking
+        — the wrapper is call-transparent, so a read-after-donate through
+        it is exactly as corrupting as through the bare jit."""
+        direct = (
+            "import jax\n"
+            "class B:\n"
+            "    def __init__(self):\n"
+            "        self.step = tracing.instrument_jit(\n"
+            "            jax.jit(run, donate_argnums=(0,)), 'step')\n"
+            "    def train(self, state, batch):\n"
+            "        out = self.step(state, batch)\n"
+            "        return state.params\n"
+        )
+        out = self._analyze(direct)
+        assert len(out) == 1 and "'state.params'" in out[0].message
+        via_factory = (
+            "class L:\n"
+            "    def __init__(self):\n"
+            "        self.step = tracing.instrument_jit(\n"
+            "            make_train_step(policy), 'train_step')\n"
+            "    def train(self, state, batch):\n"
+            "        out = self.step(state, batch)\n"
+            "        return state.params\n"
+        )
+        out = self._analyze(via_factory, {"make_train_step": (0,)})
+        assert len(out) == 1 and "'state.params'" in out[0].message
+
     def test_untrackable_donation_specs_flag_at_definition(self):
         """A donation the pass cannot position-track must say so — silent
         blindness to a donating callable is worse than any false
@@ -428,7 +457,7 @@ class TestThreadOwnership:
 
     def test_shipped_map_covers_the_mandated_classes(self):
         """ISSUE 9 names the surfaces: Learner, SnapshotEngine,
-        HealthMonitor, both transports."""
+        HealthMonitor, both transports; ISSUE 12 adds the trace writer."""
         declared = {
             cls for maps in ownership.OWNERSHIP.values() for cls in maps
         }
@@ -438,8 +467,34 @@ class TestThreadOwnership:
             "HealthMonitor",
             "TransportServer",
             "ShmTransportServer",
+            "TraceWriter",
         ):
             assert cls in declared, f"{cls} missing from OWNERSHIP"
+
+    def test_race_shape_trace_writer_producer_touches_file(self):
+        """ISSUE 12 regression fixture: trace events are enqueued
+        lock-free on producer threads and drained by ONE writer thread
+        that alone owns the file — the obvious 'quick fix' of writing
+        the file directly from the enqueue path is the race shape the
+        shipped map must flag (and the baseline stays empty)."""
+        trace_map = ownership.OWNERSHIP["dotaclient_tpu/utils/tracing.py"]
+        bad = (
+            "class TraceWriter:\n"
+            "    def enqueue(self, event):\n"
+            "        self._f.write(str(event))\n"   # producer → file: race
+        )
+        out = ownership.scan_source_with_map("x.py", bad, trace_map)
+        assert len(out) == 1
+        assert "writer thread" in out[0].message
+        assert "producer thread" in out[0].message
+        good = (
+            "class TraceWriter:\n"
+            "    def enqueue(self, event):\n"
+            "        self._queue.append(event)\n"
+            "    def _run(self):\n"
+            "        self._f.write('x')\n"
+        )
+        assert ownership.scan_source_with_map("x.py", good, trace_map) == []
 
 
 # ---------------------------------------------------------------------------

@@ -111,8 +111,9 @@ class TestRolloutRing:
             assert len(views) == n_fit
             # ring is logically empty but unreleased: the producer must
             # still see it as full and drop, not overwrite the views
+            # (items are (recv_ts, view) pairs since ISSUE 12)
             assert not actor.publish_rollout_bytes(wire)
-            assert all(bytes(v) == wire for v in views)
+            assert all(bytes(v) == wire for _ts, v in views)
         finally:
             actor.close()
             server.close()

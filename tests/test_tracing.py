@@ -1,0 +1,444 @@
+"""Pipeline tracing plane (ISSUE 12): record blobs, the lock-free writer,
+in-band wire markers on both codecs, buffer hop stamping, compile/retrace
+instrumentation, torn-line durability, and the trace_report merger on
+canned logs (the tier-1 pin for the multi-process acceptance flow)."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from dotaclient_tpu.transport import serialize as S
+from dotaclient_tpu.utils import telemetry, tracing
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _schema_module():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_telemetry_schema",
+        os.path.join(_REPO, "scripts", "check_telemetry_schema.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_tracer():
+    """Every test starts and ends with tracing OFF (the process default);
+    a leaked tracer would silently change other tests' hot paths."""
+    tracing.configure(None)
+    yield
+    tracing.configure(None)
+
+
+def _mk_record(tid="a-1-1", actor=1, wv=4):
+    rec = tracing.new_record(tid, actor, wv)
+    tracing.append_hop(rec, "collect", 10.0)
+    tracing.append_hop(rec, "encode", 11.0)
+    return rec
+
+
+class TestRecordBlob:
+    def test_round_trip_and_fixed_padding(self):
+        rec = _mk_record()
+        blob = tracing.record_to_blob(rec)
+        # fixed width: the native encoder's template cache keys on shapes,
+        # so every traced layout must present ONE blob length
+        assert len(blob) == tracing.TRACE_WIRE_LEN
+        blob2 = tracing.record_to_blob(_mk_record(tid="b-2-2", wv=12345))
+        assert len(blob2) == tracing.TRACE_WIRE_LEN
+        back = tracing.parse_blob(blob)
+        assert back["tid"] == "a-1-1"
+        assert back["pid"] == os.getpid()
+        assert back["actor"] == 1 and back["wv"] == 4
+        assert back["hops"] == [["collect", 10.0], ["encode", 11.0]]
+
+    def test_unpadded_blob_for_off_template_paths(self):
+        blob = tracing.record_to_blob(_mk_record(), pad=False)
+        assert len(blob) < tracing.TRACE_WIRE_LEN
+        assert tracing.parse_blob(blob)["tid"] == "a-1-1"
+
+    def test_garbage_parses_to_none(self):
+        assert tracing.parse_blob(b"not a record") is None
+        assert tracing.parse_blob(b"") is None
+        assert tracing.parse_blob(None) is None
+        # header present but corrupt numerics
+        assert tracing.parse_blob(b"tid=x pid=NaNish actor=1 wv=2") is None
+
+    def test_weights_record(self):
+        rec = tracing.weights_record(7)
+        assert rec["wv"] == 7 and rec["actor"] == -1
+        assert rec["hops"][0][0] == "publish"
+
+
+class TestTracerAndWriter:
+    def test_off_by_default(self):
+        assert tracing.get() is None
+
+    def test_sampling_cadence(self, tmp_path):
+        tr = tracing.configure(str(tmp_path / "t.jsonl"), sample_n=4)
+        hits = sum(tr.should_sample() for _ in range(16))
+        assert hits == 4
+
+    def test_writer_round_trip_and_close_drains(self, tmp_path):
+        reg = telemetry.Registry()
+        path = str(tmp_path / "t.jsonl")
+        tr = tracing.configure(path, sample_n=1, registry=reg)
+        tr.emit("publish", version=9)
+        tr.emit_chunk(_mk_record())
+        tracing.shutdown()
+        events = [json.loads(l) for l in telemetry.load_jsonl(path)]
+        assert [e["event"] for e in events] == ["publish", "chunk"]
+        assert events[1]["origin_pid"] == os.getpid()
+        assert reg.counter("trace/emitted_total").value == 2.0
+
+    def test_emit_chunk_snapshots_hops(self, tmp_path):
+        """The emitted event must not alias the live record — downstream
+        hop appends (the in-proc delivery path) race the writer thread's
+        serialization otherwise."""
+        path = str(tmp_path / "t.jsonl")
+        tr = tracing.configure(path, sample_n=1)
+        rec = _mk_record()
+        tr.emit_chunk(rec)
+        rec["hops"].append(["admit", 12.0])   # post-emit mutation
+        tracing.shutdown()
+        (ev,) = [json.loads(l) for l in telemetry.load_jsonl(path)]
+        assert [h[0] for h in ev["hops"]] == ["collect", "encode"]
+
+    def test_bounded_queue_drops_and_counts(self, tmp_path):
+        reg = telemetry.Registry()
+        w = tracing.TraceWriter(str(tmp_path / "t.jsonl"), registry=reg)
+        w.close()            # writer thread provably exited
+        w._stopped = False   # re-arm enqueue with NO drainer: deterministic
+        w._queue.extend(
+            {"event": "x"} for _ in range(tracing.TraceWriter.MAX_QUEUE)
+        )
+        w.enqueue({"event": "overflow"})
+        assert reg.counter("trace/dropped_total").value == 1.0
+        assert len(w._queue) == tracing.TraceWriter.MAX_QUEUE
+
+    def test_torn_trailing_line_tolerated(self, tmp_path):
+        path = str(tmp_path / "t.jsonl")
+        with open(path, "w") as f:
+            f.write('{"event": "a"}\n{"event": "b"}\n{"event": "tor')
+        lines = telemetry.load_jsonl(path)
+        assert len(lines) == 2
+        # and the schema validator reads through the SAME tolerant loader
+        assert _schema_module().load_jsonl is telemetry.load_jsonl
+
+
+class TestJsonlSinkDurability:
+    def test_every_emit_is_flushed_line(self, tmp_path):
+        path = str(tmp_path / "m.jsonl")
+        sink = telemetry.JsonlSink(path)
+        sink.emit(1, {"a": 1.0})
+        # flushed WITHOUT close: a reader (or a post-SIGKILL autopsy)
+        # sees the full line immediately
+        assert telemetry.load_jsonl(path)
+        sink.close()
+        assert len(telemetry.load_jsonl(path)) == 1
+
+
+class TestWireMarkers:
+    def arrays(self):
+        return {
+            "obs": {"x": np.arange(12, dtype=np.float32).reshape(3, 4)},
+            "rewards": np.ones(3, np.float32),
+        }
+
+    def test_trace_rides_both_codecs(self):
+        blob = tracing.record_to_blob(_mk_record())
+        arrays = self.arrays()
+        native = bytes(S.encode_rollout_bytes(arrays, 1, 2, 3, 3, 0.0,
+                                              trace=blob))
+        proto = S.encode_rollout(arrays, 1, 2, 3, 3, 0.0,
+                                 trace=blob).SerializeToString()
+        for wire, native_flag in ((native, True), (proto, False)):
+            meta, out = S.decode_rollout_bytes(wire, native=native_flag)
+            rec = tracing.parse_blob(meta["trace_blob"])
+            assert rec["tid"] == "a-1-1"
+            np.testing.assert_array_equal(
+                np.asarray(out["rewards"]), arrays["rewards"]
+            )
+
+    def test_untraced_frames_carry_no_marker(self):
+        meta, _ = S.decode_rollout_bytes(
+            bytes(S.encode_rollout_bytes(self.arrays(), 1, 2, 3, 3, 0.0))
+        )
+        assert "trace_blob" not in meta
+
+    def test_weights_marker_round_trip_and_skip(self):
+        blob = tracing.record_to_blob(tracing.weights_record(5), pad=False)
+        msg = S.encode_weights({"w": np.ones(4, np.float32)}, 5, trace=blob)
+        assert S.weights_trace(msg) == blob
+        version, tree = S.decode_weights(msg)
+        # the marker must never surface as a param leaf
+        assert version == 5 and list(tree) == ["w"]
+        assert S.weights_trace(S.encode_weights({"w": np.ones(2)}, 1)) is None
+
+    def test_decode_drained_stamps_hops_only_when_tracing(self, tmp_path):
+        blob = tracing.record_to_blob(_mk_record())
+        wire = bytes(
+            S.encode_rollout_bytes(self.arrays(), 1, 2, 3, 3, 0.0,
+                                   trace=blob)
+        )
+        reg = telemetry.Registry()
+        # tracing OFF: raw blob is carried but never parsed/stamped
+        out, bad = S.decode_drained_payloads([(123.0, wire)], reg, [0, 0])
+        assert bad == 0 and "trace" not in out[0][0]
+        # tracing ON: recv + consume hops land on the host record
+        tracing.configure(str(tmp_path / "t.jsonl"), sample_n=1)
+        out, _ = S.decode_drained_payloads([(123.0, wire)], reg, [0, 0])
+        rec = out[0][0]["trace"]
+        names = [h[0] for h in rec["hops"]]
+        assert names == ["collect", "encode", "recv", "consume"]
+        assert rec["hops"][2][1] == 123.0
+        # bare (untupled) payloads stay accepted — recv hop simply absent
+        out, _ = S.decode_drained_payloads([wire], reg, [0, 0])
+        assert [h[0] for h in out[0][0]["trace"]["hops"]] == [
+            "collect", "encode", "consume",
+        ]
+
+
+class TestBufferTraceFlow:
+    def _cfg(self):
+        import dataclasses
+
+        from dotaclient_tpu.config import default_config
+
+        cfg = default_config()
+        return dataclasses.replace(
+            cfg,
+            env=dataclasses.replace(cfg.env, n_envs=4, max_dota_time=60.0),
+            ppo=dataclasses.replace(
+                cfg.ppo, rollout_len=8, batch_rollouts=8
+            ),
+            buffer=dataclasses.replace(
+                cfg.buffer, capacity_rollouts=16, min_fill=8
+            ),
+        )
+
+    def _rollouts(self, cfg, n=8, traced=True):
+        from dotaclient_tpu.train.ppo import example_batch
+
+        row = jax.tree.map(
+            lambda x: np.asarray(x[0]), example_batch(cfg, batch=1)
+        )
+        out = []
+        for i in range(n):
+            meta = {"model_version": 0, "env_id": 0, "rollout_id": i,
+                    "length": 8, "total_reward": 0.0}
+            if traced:
+                meta["trace"] = _mk_record(tid=f"t-{i}", wv=0)
+            out.append((meta, jax.tree.map(np.copy, row)))
+        return out
+
+    def test_admit_gather_hops_and_drain(self, tmp_path):
+        from dotaclient_tpu.buffer.trajectory_buffer import TrajectoryBuffer
+        from dotaclient_tpu.parallel import make_mesh
+
+        cfg = self._cfg()
+        tracing.configure(str(tmp_path / "t.jsonl"), sample_n=1)
+        buf = TrajectoryBuffer(cfg, make_mesh(cfg.mesh))
+        assert buf.add(self._rollouts(cfg), 0) == 8
+        assert buf.take(batch_size=8) is not None
+        traces = buf.drain_traces()
+        assert len(traces) == 8
+        for rec in traces:
+            assert [h[0] for h in rec["hops"]] == [
+                "collect", "encode", "admit", "gather",
+            ]
+        assert buf.drain_traces() == []   # drained exactly once
+
+    def test_tracing_off_costs_one_pointer_test(self):
+        """The utils/faults.py discipline, pinned: with no tracer the
+        buffer allocates NO per-slot trace state and take() parks
+        nothing — the hot path's entire cost is `self._tracer is None`."""
+        from dotaclient_tpu.buffer.trajectory_buffer import TrajectoryBuffer
+        from dotaclient_tpu.parallel import make_mesh
+
+        assert tracing.get() is None
+        cfg = self._cfg()
+        buf = TrajectoryBuffer(cfg, make_mesh(cfg.mesh))
+        assert buf._tracer is None and buf._slot_trace is None
+        buf.add(self._rollouts(cfg, traced=False), 0)
+        assert buf.take(batch_size=8) is not None
+        assert buf.drain_traces() == []
+
+
+class TestInstrumentJit:
+    def test_compile_retrace_counters_and_cost_once(self, tmp_path):
+        reg = telemetry.Registry()
+        path = str(tmp_path / "t.jsonl")
+        tracing.configure(path, sample_n=1, registry=reg)
+        fn = tracing.instrument_jit(
+            jax.jit(lambda x: x + 1), "train_step", reg
+        )
+        out = fn(np.zeros((3,), np.float32))
+        assert np.asarray(out).shape == (3,)
+        snap = reg.snapshot()
+        assert snap["compile/compiles_total"] == 1.0
+        assert snap["compile/retraces_total"] == 0.0
+        assert snap["compile/train_step/compiles_total"] == 1.0
+        fn(np.ones((3,), np.float32))   # cache hit: no new compile
+        assert reg.snapshot()["compile/compiles_total"] == 1.0
+        # the acceptance pin: a shape bump retraces and is COUNTED
+        fn(np.zeros((4,), np.float32))
+        snap = reg.snapshot()
+        assert snap["compile/compiles_total"] == 2.0
+        assert snap["compile/retraces_total"] == 1.0
+        assert snap["compile/train_step/retraces_total"] == 1.0
+        assert snap["compile/compile_time_s_total"] > 0.0
+        tracing.shutdown()
+        compiles = [
+            json.loads(l)
+            for l in telemetry.load_jsonl(path)
+            if json.loads(l)["event"] == "compile"
+        ]
+        # cost analysis logged once PER COMPILE, never per step:
+        # 3 calls, 2 compiles, exactly 2 events
+        assert len(compiles) == 2
+        assert all(ev["program"] == "train_step" for ev in compiles)
+
+    def test_delegates_introspection(self):
+        fn = tracing.instrument_jit(jax.jit(lambda x: x * 2), "snap_copy",
+                                    telemetry.Registry())
+        lowered = fn.lower(np.zeros((2,), np.float32))
+        assert lowered is not None   # .lower reaches the wrapped jit
+
+    def test_memory_gauge_degrades_on_cpu(self):
+        reg = telemetry.Registry()
+        peak = tracing.update_memory_gauges(reg)
+        # CPU backend: no allocator stats → 0, but the key EXISTS
+        assert "mem/hbm_peak_bytes" in reg.snapshot()
+        assert peak >= 0.0
+
+
+class TestSchemaTier:
+    def test_require_trace_tier(self):
+        schema = _schema_module()
+        reg = telemetry.Registry()
+        tracing.ensure_metrics(reg)
+        scalars = dict(reg.snapshot())
+        line = json.dumps({"ts": 1.0, "step": 0, "scalars": scalars})
+        errs = schema.validate_lines(
+            [line], extra_required=schema.TRACE_KEYS, base_required=()
+        )
+        assert errs == []
+        scalars.pop("compile/retraces_total")
+        line = json.dumps({"ts": 1.0, "step": 0, "scalars": scalars})
+        errs = schema.validate_lines(
+            [line], extra_required=schema.TRACE_KEYS, base_required=()
+        )
+        assert any("compile/retraces_total" in e for e in errs)
+
+
+class TestTraceReport:
+    """The tier-1 pin of the acceptance flow, on canned logs: an 'actor'
+    log with partial records (one SIGKILL-torn), a 'learner' log with the
+    complete timelines + publish events, one 'apply' event — the merge
+    must produce the histogram, the critical path, and the staleness
+    attribution."""
+
+    def _write_canned(self, tmp_path):
+        t0 = 1000.0
+        actor_pid, learner_pid = 111, 222
+        actor_lines = []
+        learner_lines = [
+            json.dumps({"ts": t0, "pid": learner_pid, "event": "publish",
+                        "version": 3}),
+            json.dumps({"ts": t0 + 0.01, "pid": actor_pid, "event": "apply",
+                        "version": 3, "publish_ts": t0}),
+        ]
+        for i in range(6):
+            base = t0 + 0.02 + i * 0.1
+            hops = [
+                ["collect", base], ["encode", base + 0.050],
+            ]
+            full = hops + [
+                ["recv", base + 0.055], ["consume", base + 0.060],
+                ["admit", base + 0.062], ["gather", base + 0.080],
+                ["dispatch", base + 0.090],
+            ]
+            actor_lines.append(json.dumps(
+                {"ts": base, "pid": actor_pid, "event": "chunk",
+                 "tid": f"c-{i}", "origin_pid": actor_pid, "actor": 1,
+                 "wv": 3, "hops": hops}
+            ))
+            learner_lines.append(json.dumps(
+                {"ts": base, "pid": learner_pid, "event": "chunk",
+                 "tid": f"c-{i}", "origin_pid": actor_pid, "actor": 1,
+                 "wv": 3, "hops": full}
+            ))
+        # a serve client's round-trip record shares the log directory: it
+        # carries encode/recv hops too, but must NOT contaminate the
+        # rollout pipeline's wire segment or chunk counts (review fix)
+        serve_pid = 333
+        learner_lines.append(json.dumps(
+            {"ts": t0 + 2.0, "pid": serve_pid, "event": "chunk",
+             "tid": "s-0", "origin_pid": serve_pid, "actor": 0, "wv": 3,
+             "hops": [["encode", t0 + 2.0], ["recv", t0 + 9.0],
+                      ["reply", t0 + 9.001], ["done", t0 + 9.002]]}
+        ))
+        apath = tmp_path / "actor0.trace.jsonl"
+        lpath = tmp_path / "learner.trace.jsonl"
+        # the actor was SIGKILLed mid-line: torn tail, no newline
+        apath.write_text("\n".join(actor_lines) + "\n" + '{"event": "to')
+        lpath.write_text("\n".join(learner_lines) + "\n")
+        return str(tmp_path), 111
+
+    def test_merged_report(self, tmp_path):
+        from scripts.trace_report import build_report
+
+        run_dir, actor_pid = self._write_canned(tmp_path)
+        rep = build_report([run_dir])
+        assert rep["chunks_complete"] == 6
+        assert rep["origin_pids"] == [actor_pid]
+        # the SIGKILL-torn tail was dropped by the tolerant loader before
+        # parsing — it neither errors nor becomes a phantom event
+        assert rep["lines_skipped"] == 0 and rep["chunks_seen"] == 6
+        # (a) the end-to-end histogram
+        assert rep["e2e_latency_s"]["n"] == 6
+        assert abs(rep["e2e_latency_s"]["mean"] - 0.090) < 1e-6
+        assert rep["e2e_histogram"]
+        # (b) the per-hop critical-path breakdown
+        cp = rep["critical_path"]
+        for segment in ("actor compute", "wire", "drain wait",
+                        "admission", "ring residency", "dispatch wait"):
+            assert cp[segment]["n"] == 6, segment
+        assert abs(cp["actor compute"]["mean"] - 0.050) < 1e-6
+        # the serve record's 7s encode→recv gap must NOT bleed into the
+        # pipeline's wire segment (it is reported under serve RTTs)
+        assert abs(cp["wire"]["mean"] - 0.005) < 1e-6
+        assert rep["serve"]["rtt_s"]["n"] == 1
+        # (c) the staleness attribution table
+        st = rep["staleness"]
+        # per-CHUNK attribution: every traced chunk contributes one sample
+        # per component it can close (all six collected under version 3)
+        assert st["components"]["publish→apply (fanout)"]["n"] == 6
+        assert st["components"]["apply→encode (actor hold)"]["n"] == 6
+        assert st["dominant"] is not None
+        assert st["weights_age_at_dispatch_s"]["n"] == 6
+
+    def test_cli_json_mode(self, tmp_path, capsys):
+        from scripts.trace_report import main as report_main
+
+        run_dir, _ = self._write_canned(tmp_path)
+        assert report_main(["--json", run_dir]) == 0
+        out = capsys.readouterr().out
+        line = [l for l in out.splitlines() if l.startswith("TRACE_REPORT ")]
+        assert line and json.loads(line[0][len("TRACE_REPORT "):])[
+            "chunks_complete"
+        ] == 6
+
+    def test_empty_input_exits_nonzero(self, tmp_path):
+        from scripts.trace_report import main as report_main
+
+        (tmp_path / "empty.jsonl").write_text("")
+        assert report_main(["--json", str(tmp_path)]) == 1
