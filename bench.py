@@ -421,6 +421,92 @@ def bench_trace(config) -> dict:
     return out
 
 
+def bench_fleet(config) -> dict:
+    """Fleet stage (ISSUE 13): fused-path step throughput with the fleet
+    health plane OFF vs ON.
+
+    "On" is the full learner-side cost at an aggressive 50 ms cadence: a
+    live FleetAggregator thread merging 4 synthetic peers' encoded
+    snapshot frames (the real codec path) and evaluating the whole alert
+    rule table every tick — an order of magnitude hotter than the 5 s
+    production cadence, so the budget has nowhere to hide. The train
+    thread itself does NOTHING fleet-related by construction (aggregation
+    lives on the aggregator thread; the disabled actor-side cost is one
+    pointer test, pinned by test), so the acceptance budget is
+    ``fleet_overhead`` ≤ 2% of fused throughput. The PR 12 trace-stage
+    pattern: best-of-2 segments per variant on this noise-prone host."""
+    import dataclasses
+    import threading
+
+    from dotaclient_tpu.train.learner import Learner
+    from dotaclient_tpu.utils import telemetry
+    from dotaclient_tpu.utils.fleet import FleetAggregator, encode_snapshot
+
+    base = dataclasses.replace(
+        config,
+        env=dataclasses.replace(
+            config.env, n_envs=128, opponent="scripted_easy",
+            max_dota_time=120.0,
+        ),
+        log_every=10**9,   # no boundaries: the fleet plane is the subject
+    )
+    steps = 100
+    out: dict = {}
+    for label in ("off", "on"):
+        agg = None
+        feeder = None
+        stop = threading.Event()
+        if label == "on":
+            agg = FleetAggregator(interval_s=0.05, emit_event=None)
+            agg.start()
+
+            def _feed() -> None:
+                env_steps = 0.0
+                seq = 0
+                while not stop.wait(0.05):
+                    env_steps += 512.0
+                    seq += 1
+                    for peer in range(4):
+                        agg.ingest(
+                            encode_snapshot(
+                                peer, "actor", seq,
+                                {"actor/env_steps": env_steps,
+                                 "transport/reconnects_total": 0.0},
+                                {"actor/weight_refresh_lag": 1.0},
+                            )
+                        )
+
+            feeder = threading.Thread(
+                target=_feed, name="fleet-bench-feeder", daemon=True
+            )
+            feeder.start()
+        learner = Learner(base, actor="fused")
+        try:
+            learner.train(10)   # compile + settle
+            best = 0.0
+            for _ in range(2):
+                t0 = time.perf_counter()
+                learner.train(steps)
+                best = max(best, steps / (time.perf_counter() - t0))
+            out[f"{label}_steps_per_sec"] = round(best, 2)
+        finally:
+            if learner._snap_engine is not None:
+                learner._snap_engine.stop()
+            stop.set()
+            if feeder is not None:
+                feeder.join(timeout=2.0)
+            if agg is not None:
+                agg.stop()
+        if label == "on":
+            snap = telemetry.get_registry().snapshot()
+            out["snapshots_merged"] = snap.get("fleet/snapshots_total", 0.0)
+    off, on = out["off_steps_per_sec"], out["on_steps_per_sec"]
+    out["fleet_overhead"] = (
+        round(max(0.0, 1.0 - on / off), 4) if off else 1.0
+    )
+    return out
+
+
 def bench_quantize(config) -> dict:
     """Quantize stage (ISSUE 7): the rollout experience plane, narrow vs f32.
 
@@ -957,6 +1043,15 @@ def main() -> None:
     except Exception as e:
         trace = {"error": f"{type(e).__name__}: {e}"}
 
+    # -- fleet stage: metrics fanout + alert evaluation on vs off (ISSUE 13) -
+    try:
+        fleet = bench_fleet(config)
+        # acceptance: fleet_overhead ≤ 0.02 — aggregation/alerting live on
+        # the aggregator thread, never the train thread's hot path
+        stages["fleet_overhead"] = fleet.get("fleet_overhead", 1.0)
+    except Exception as e:
+        fleet = {"error": f"{type(e).__name__}: {e}"}
+
     # -- quantize stage: narrow-dtype experience plane (ISSUE 7) -------------
     try:
         quantize = bench_quantize(config)
@@ -1025,6 +1120,7 @@ def main() -> None:
                 "stall": stall,
                 "health": health,
                 "trace": trace,
+                "fleet": fleet,
                 "quantize": quantize,
                 "multichip": multichip,
                 "serve": serve,

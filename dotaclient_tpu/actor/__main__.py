@@ -149,6 +149,13 @@ def main(argv=None) -> int:
                    help="with --trace-jsonl: trace every Nth shipped "
                         "chunk (default telemetry.trace_sample_n = 16; "
                         "1 = every chunk)")
+    p.add_argument("--fleet-interval", type=float, default=None, metavar="S",
+                   help="fleet health plane (ISSUE 13): push one compact "
+                        "metric snapshot (counters + gauges) to the learner "
+                        "every S seconds over the rollout lane (default "
+                        "telemetry.fleet_interval_s = 5; 0 disables). The "
+                        "learner's FleetAggregator merges them into the "
+                        "fleet/<peer>/* keys and the alert rules")
     p.add_argument("--idle-timeout", type=float, default=None,
                    help="seconds of learner silence (no weights OR "
                         "heartbeats) before declaring the connection "
@@ -198,11 +205,18 @@ def main(argv=None) -> int:
     from dotaclient_tpu.config import default_config
     from dotaclient_tpu.models import init_params, make_policy
     from dotaclient_tpu.transport import decode_weights
-    from dotaclient_tpu.utils import tracing
+    from dotaclient_tpu.utils import fleet, tracing
 
     if args.trace_jsonl:
         # before the pool exists: it captures tracing.get() at init
         tracing.configure(args.trace_jsonl, sample_n=args.trace_sample)
+    # fleet publisher BEFORE the pool for the same reason (it captures
+    # fleet.get() at init); the peer id is the actor seed, so a
+    # supervisor-restarted incarnation reports under the SAME fleet row
+    # (its fresh pid resets the aggregator's counter-delta base)
+    fleet.configure(
+        peer_id=args.seed, kind="actor", interval_s=args.fleet_interval
+    )
 
     config = default_config()
     config = dataclasses.replace(

@@ -26,7 +26,7 @@ import numpy as np
 
 from dotaclient_tpu.actor.window_stats import WindowedStatsMixin
 from dotaclient_tpu.config import RunConfig
-from dotaclient_tpu.utils import faults, telemetry, tracing
+from dotaclient_tpu.utils import faults, fleet, telemetry, tracing
 from dotaclient_tpu.envs.vec_lane_sim import (
     OPPONENT_CONTROL,
     VecLaneSim,
@@ -169,6 +169,11 @@ class VecActorPool(WindowedStatsMixin):
         self.wins = 0
         self._tel = telemetry.get_registry()
         self._faults = faults.get()   # None unless chaos injection is on
+        # Fleet-health publisher (ISSUE 13): captured ONCE like the fault
+        # registry and the tracer — with the fanout off (in-proc pools,
+        # --fleet-interval 0) the run loop pays exactly one `is not None`
+        # test per refresh boundary (pinned by test).
+        self._fleet = fleet.get()
         # Pipeline tracing (ISSUE 12): the tracer is captured ONCE, like
         # the fault registry — with tracing off the ship path pays exactly
         # one `is not None` test per emit batch (pinned by test). Per-lane
@@ -454,6 +459,11 @@ class VecActorPool(WindowedStatsMixin):
         for t in range(n_steps):
             if refresh_every and t % refresh_every == 0:
                 self.refresh_weights()
+                if self._fleet is not None and self.transport is not None:
+                    # cadence-gated inside (one clock compare); send
+                    # errors propagate like a failed rollout publish —
+                    # the actor's reconnect machinery owns them
+                    self._fleet.maybe_publish(self.transport)
             self.step()
         return self.stats()
 

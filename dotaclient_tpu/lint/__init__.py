@@ -8,6 +8,7 @@ Import-light by design (stdlib only — no jax/numpy): the tier-1 wrapper
 (tests/test_lint.py) runs the full lint in-process on every test run.
 """
 
+from dotaclient_tpu.lint.alert_drift import AlertDriftRule
 from dotaclient_tpu.lint.config_drift import ConfigCliDriftRule
 from dotaclient_tpu.lint.core import (
     DEFAULT_BASELINE,
@@ -31,6 +32,7 @@ ALL_RULES = (
     ThreadOwnershipRule,
     TelemetryDriftRule,
     ConfigCliDriftRule,
+    AlertDriftRule,
 )
 
 __all__ = [

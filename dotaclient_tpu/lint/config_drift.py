@@ -58,6 +58,7 @@ ALL_CLIS = OPERATOR_CLIS + (
     "dotaclient_tpu/serve/__main__.py",
     "scripts/serve_loadgen.py",
     "scripts/chaos_run.py",
+    "scripts/fleet_status.py",
     "scripts/run_multichip.py",
     "scripts/train_demo.py",
     "scripts/curriculum_5v5.py",

@@ -253,6 +253,36 @@ OWNERSHIP: Dict[str, Dict[str, ClassMap]] = {
             },
         ),
     },
+    "dotaclient_tpu/utils/fleet.py": {
+        # Fleet health plane (ISSUE 13): the three-way split the module
+        # docstring declares, machine-checked. INGEST runs on transport
+        # reader threads (socket) or the learner's consume thread (shm
+        # drain) and may only park decoded snapshots in the locked inbox;
+        # the MERGE/ROLLUP/ALERT state — per-peer tables and the alert
+        # engine's rule state — belongs to the aggregator's own thread
+        # alone (an unlocked cross-thread rule-state touch is the pinned
+        # regression fixture in tests/test_lint.py); everything else
+        # reads through the thread-safe telemetry registry.
+        "FleetAggregator": ClassMap(
+            default_thread="learner",   # construct/start/stop: owner side
+            methods={
+                "ingest": "reader",
+                "_run": "agg",
+                "tick": "agg",
+                "_merge": "agg",
+                "_rollup": "agg",
+                "_peer_counter": "agg",
+                "_peer_gauge": "agg",
+                "_peer_metric": "agg",
+            },
+            attrs={
+                "_inbox": "lock:_lock",
+                "_peers": "agg",
+                "_engine": "agg",
+                "_thread": "learner",
+            },
+        ),
+    },
     "dotaclient_tpu/transport/shm_transport.py": {
         # Single-consumer by design: every method runs on the learner
         # thread (no background threads in the shm server — liveness is

@@ -75,6 +75,22 @@ DYNAMIC_KEY_EXPANSIONS: Dict[Tuple[str, str], Tuple[str, ...]] = {
     ("compile/", "/compiles_total"): _INSTRUMENTED_PROGRAMS,
     ("compile/", "/retraces_total"): _INSTRUMENTED_PROGRAMS,
     ("compile/", "/last_compile_s"): _INSTRUMENTED_PROGRAMS,
+    # utils/fleet.py FleetAggregator rollups: fleet/agg/<metric>/<stat>
+    # gauges across live peers — keep in sync with fleet.AGG_SOURCES ×
+    # AGG_STATS and the FLEET_KEYS schema tier
+    ("fleet/agg/", ""): (
+        "weight_staleness/min", "weight_staleness/max",
+        "weight_staleness/mean",
+        "env_fps/min", "env_fps/max", "env_fps/mean",
+        "reconnects/min", "reconnects/max", "reconnects/mean",
+        "corrupt_frames/min", "corrupt_frames/max", "corrupt_frames/mean",
+    ),
+    # utils/fleet.py per-peer mirror keys: fleet/<peer>/<shipped metric>
+    # (peer labels are runtime values — representative members here; the
+    # family is documented as the `fleet/<peer>/*` wildcard row)
+    ("fleet/", ""): (
+        "a0/actor/env_steps", "a0/env_fps",
+    ),
 }
 
 # Token shape of a telemetry key in backticked doc text: slash-separated
@@ -89,9 +105,9 @@ _DOC_KEY_RE = re.compile(
 # `carry0/*`) — never treated as documented-telemetry claims. A NEW
 # namespace must be added here when its first key is minted.
 KEY_PREFIXES = (
-    "actor/", "buffer/", "checkpoint/", "compile/", "faults/", "health/",
-    "league/", "learner/", "mem/", "mesh/", "serve/", "shm/", "snapshot/",
-    "span/", "trace/", "transport/",
+    "actor/", "alerts/", "buffer/", "checkpoint/", "compile/", "faults/",
+    "fleet/", "health/", "league/", "learner/", "mem/", "mesh/", "serve/",
+    "shm/", "snapshot/", "span/", "trace/", "transport/",
 )
 # single-line inline code only: multi-line matches would mispair across
 # ``` fence lines (odd backtick count flips pairing for the whole doc)

@@ -59,6 +59,13 @@ def main(argv=None) -> int:
     p.add_argument("--trace-sample", type=int, default=None, metavar="N",
                    help="with --trace-jsonl: trace every Nth request "
                    "(default telemetry.trace_sample_n = 16)")
+    p.add_argument("--fleet-interval", type=float, default=None, metavar="S",
+                   help="fleet health plane (ISSUE 13): with --subscribe, "
+                   "push one compact metric snapshot (serve counters + "
+                   "gauges) back to the learner every S seconds over the "
+                   "subscription lane (default telemetry.fleet_interval_s "
+                   "= 5; 0 disables) — the fleet console then shows serve "
+                   "p99 next to the actors")
     p.add_argument("--duration", type=float, default=0.0,
                    help="serve for this many seconds then exit (0 = forever)")
     args = p.parse_args(argv)
@@ -117,15 +124,54 @@ def main(argv=None) -> int:
         server.attach_weights_source(source)
         print(f"serve: subscribed to weights fanout {args.subscribe}", flush=True)
 
+    publisher = None
+    if args.subscribe:
+        # fleet health plane (ISSUE 13): the weights-subscription lane is
+        # the serve process's channel back to the learner — ride metric
+        # snapshots on it so the fleet console shows this server's p99
+        from dotaclient_tpu.utils.fleet import FleetPublisher
+
+        interval = (
+            telemetry.fleet_interval_s
+            if args.fleet_interval is None
+            else args.fleet_interval
+        )
+        if interval > 0:
+            # peer id = the bound listen port, NOT the pid: a restarted
+            # serve process must reuse its fleet row so the
+            # fleet_peer_stale page resolves on its first fresh snapshot
+            # (a pid-keyed row would stay stale — and paging — until the
+            # aggregator's forget window). Ephemeral-port servers
+            # (--serve-listen :0) get a fresh row per boot by nature.
+            publisher = FleetPublisher(
+                peer_id=int(server.address[1]) & 0xFFFF, kind="serve",
+                interval_s=interval,
+            )
+
     sink = None
     if args.serve_metrics_jsonl:
         sink = telemetry.JsonlSink(args.serve_metrics_jsonl)
     tel = telemetry.get_registry()
     t_end = time.time() + args.duration if args.duration else None
+    # the wake interval follows the fleet cadence so snapshots publish on
+    # time, but the JSONL sink keeps its OWN historical 5 s cadence —
+    # --fleet-interval must not silently multiply the metrics log volume
+    wake = min(5.0, publisher.interval_s) if publisher is not None else 5.0
+    sink_every = 5.0
+    last_sink = time.monotonic()
     try:
         while t_end is None or time.time() < t_end:
-            time.sleep(min(5.0, t_end - time.time()) if t_end else 5.0)
-            if sink is not None:
+            time.sleep(min(wake, t_end - time.time()) if t_end else wake)
+            if publisher is not None:
+                try:
+                    publisher.maybe_publish(source)
+                except (ConnectionError, OSError):
+                    pass   # learner gone: serving continues on last weights
+            if (
+                sink is not None
+                and time.monotonic() - last_sink >= sink_every
+            ):
+                last_sink = time.monotonic()
                 snap = tel.snapshot()
                 sink.emit(int(snap.get("serve/dispatches_total", 0)), snap)
     except KeyboardInterrupt:

@@ -539,6 +539,23 @@ class Learner:
                     )
         self.telemetry = telemetry.get_registry()
         self.metrics = MetricsLogger(logdir, jsonl=metrics_jsonl)
+        # Fleet health plane (ISSUE 13): the aggregator is ALWAYS
+        # constructed — that alone eager-creates every fleet/ + alerts/
+        # key, so `check_telemetry_schema.py --require-fleet` validates
+        # any learner JSONL deterministically. Its merge/alert thread
+        # only STARTS when a fleet can actually report (the external
+        # transports); transport reader threads hand it kind-5 metric
+        # snapshot frames via `metrics_handler`, and ALERT events ride
+        # the metrics JSONL's flush-per-emit durability.
+        from dotaclient_tpu.utils.fleet import FleetAggregator
+
+        self.fleet = FleetAggregator(
+            registry=self.telemetry, emit_event=self.metrics.emit_event
+        )
+        if transport is not None and hasattr(transport, "metrics_handler"):
+            transport.metrics_handler = self.fleet.ingest
+        if mode == "external" and telemetry.fleet_interval_s > 0:
+            self.fleet.start()
         self.frames_per_rollout = config.ppo.rollout_len
         # Minibatch machinery: one jitted gather (a tree of row-gathers is
         # otherwise a dispatch per leaf), host RNG for the shuffles, and the
@@ -1894,6 +1911,14 @@ def main(argv=None) -> Dict[str, float]:
         "chaos-harness setting)",
     )
     p.add_argument(
+        "--fleet-interval", type=float, default=None, metavar="S",
+        help="fleet health plane (ISSUE 13): aggregate actor/serve metric "
+        "snapshots and evaluate the alert rules every S seconds (default "
+        "telemetry.fleet_interval_s = 5; 0 disables the fanout — the "
+        "fleet/ and alerts/ keys stay eager-created). External-transport "
+        "modes only; read the merged table with scripts/fleet_status.py",
+    )
+    p.add_argument(
         "--checkify", action="store_true",
         help="debug numerics: checkify-instrumented train step that raises "
         "on the first NaN/Inf (slow; never for production runs)",
@@ -2047,6 +2072,10 @@ def main(argv=None) -> Dict[str, float]:
     # tracing.get() at construction (the faults.get() discipline)
     if args.trace_jsonl:
         tracing.configure(args.trace_jsonl, sample_n=args.trace_sample)
+    if args.fleet_interval is not None:
+        # before the Learner exists: its FleetAggregator reads the knob
+        # at construction (telemetry.fleet_interval_s is the one source)
+        telemetry.fleet_interval_s = args.fleet_interval
 
     transport = None
     if args.transport == "socket":
@@ -2169,6 +2198,9 @@ def main(argv=None) -> Dict[str, float]:
             # on the writer thread's per-batch flush and the torn-line-
             # tolerant reader)
             tracing.shutdown()
+        # the fleet aggregator thread outlives train() by design (the
+        # tail still merges late snapshots); main is its owner
+        learner.fleet.stop()
         if transport is not None and hasattr(transport, "close"):
             # deterministic teardown even when train() raises: the shm
             # server unlinks its segments (the resource tracker would

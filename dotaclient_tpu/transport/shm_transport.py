@@ -10,7 +10,10 @@ protocol over POSIX shared memory instead (ISSUE 3):
 * **rollout lane** — one single-producer/single-consumer byte ring per
   actor slot. The producer (actor) writes ``u32 length + payload +
   u32 crc32`` frames (the CRC trailer is ``serialize.frame_crc32`` —
-  ISSUE 4 wire integrity) and bumps a cumulative ``tail``; the consumer
+  ISSUE 4 wire integrity; the length word's HIGH BIT marks a fleet
+  metrics snapshot frame, ISSUE 13 — same CRC/quarantine semantics,
+  routed to ``metrics_handler`` instead of the consume path) and bumps a
+  cumulative ``tail``; the consumer
   (learner) copies frames out and bumps ``head``. No locks: SPSC with
   cumulative 8-byte counters (written only by their owning side) needs
   none. A full ring drops the NEW frame (counted in the ring header — the
@@ -92,6 +95,14 @@ _OFF_CRC = 32          # weights-payload crc32 (wire integrity, ISSUE 4)
 _SLAB_HDR = 40
 
 _FRAME_OVERHEAD = 8    # u32 length prefix + u32 crc32 trailer per ring frame
+
+# Ring frames carry no kind byte (every frame was a rollout until ISSUE
+# 13); the length word's high bit marks a fleet-health metrics snapshot
+# instead. Ring capacities are far below 2^31, so the bit is free, the
+# length-plausibility check masks it off first, and the CRC/quarantine
+# semantics are IDENTICAL for both frame kinds (pinned by test).
+_METRICS_FLAG = 0x80000000
+_LEN_MASK = 0x7FFFFFFF
 
 # Slot-claim lockfiles live next to the segments. SharedMemory maps names
 # into /dev/shm on Linux; the lockfile's O_CREAT|O_EXCL creation is the
@@ -326,6 +337,10 @@ class ShmTransportServer:
         self._tel.counter("transport/rollout_raw_bytes_total")
         self._tel.gauge("transport/rollout_compression_ratio").set(1.0)
         self._rollout_totals = [0, 0]   # [wire bytes, raw bytes] consumed
+        # Fleet-health snapshot sink (ISSUE 13): the learner's
+        # FleetAggregator assigns its `ingest` here; the drain hands it
+        # every CRC-verified metrics frame (length-word high bit).
+        self.metrics_handler = None
 
     # -- rollout lane ------------------------------------------------------
 
@@ -388,9 +403,13 @@ class ShmTransportServer:
         while head < tail and len(out) < budget:
             pos = head % N
             if pos + 4 <= N:
-                length = _U32.unpack_from(mv, _RING_HDR + pos)[0]
+                word = _U32.unpack_from(mv, _RING_HDR + pos)[0]
             else:
-                length = _U32.unpack(_ring_read(mv, N, pos, 4))[0]
+                word = _U32.unpack(_ring_read(mv, N, pos, 4))[0]
+            # high bit = fleet metrics snapshot (ISSUE 13); the masked
+            # length feeds the SAME plausibility/CRC/quarantine path
+            is_metrics = bool(word & _METRICS_FLAG)
+            length = word & _LEN_MASK
             if (
                 length > N - _FRAME_OVERHEAD
                 or _FRAME_OVERHEAD + length > tail - head
@@ -419,6 +438,16 @@ class ShmTransportServer:
                     break
                 continue
             self._bad_streak[i] = 0
+            if is_metrics:
+                # copied out (small frames) before the view's deferred
+                # release; never delivered to the rollout consume path
+                handler = self.metrics_handler
+                if handler is not None:
+                    try:
+                        handler(bytes(payload))
+                    except Exception:  # noqa: BLE001
+                        pass   # a broken sink must never break the drain
+                continue
             out.append(payload)
         if consumed:
             self._consumed[i] += consumed
@@ -701,7 +730,13 @@ class ShmTransport:
     def publish_rollout(self, rollout: pb.Rollout) -> None:
         self.publish_rollout_bytes(rollout.SerializeToString())
 
-    def publish_rollout_bytes(self, payload) -> bool:
+    def publish_metrics_bytes(self, payload) -> bool:
+        """One fleet-health snapshot frame (ISSUE 13): identical ring
+        framing with the length word's high bit set — same CRC trailer,
+        same drop-when-full, same quarantine exposure on the drain side."""
+        return self.publish_rollout_bytes(payload, _word_flag=_METRICS_FLAG)
+
+    def publish_rollout_bytes(self, payload, _word_flag: int = 0) -> bool:
         """One frame into the SPSC ring; returns False (counted drop) when
         full — the actor never blocks on a slow learner.
 
@@ -733,14 +768,15 @@ class ShmTransport:
                 time.sleep(delay)
             if f.fire("transport.corrupt_frame"):
                 crc ^= 0xDEADBEEF
+        word = n | _word_flag
         pos = tail % N
         if pos + need <= N:        # common case: no wrap, three direct writes
             base = _RING_HDR + pos
-            _U32.pack_into(mv, base, n)
+            _U32.pack_into(mv, base, word)
             mv[base + 4:base + 4 + n] = payload
             _U32.pack_into(mv, base + 4 + n, crc)
         else:
-            _ring_write(mv, N, pos, _U32.pack(n))
+            _ring_write(mv, N, pos, _U32.pack(word))
             _ring_write(mv, N, pos + 4, payload)
             _ring_write(mv, N, pos + 4 + n, _U32.pack(crc))
         # tail moves only after the payload is in place: the consumer never

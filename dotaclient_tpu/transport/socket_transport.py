@@ -8,7 +8,9 @@ anywhere — including this sandbox, which has no broker — with
 ``AmqpTransport`` remaining the drop-in for clusters that do run one.
 
 Wire format per frame: 1 byte kind (0 = Rollout, 1 = ModelWeights,
-2 = heartbeat) + 4 bytes big-endian payload length + 4 bytes CRC32 of
+2 = heartbeat, 5 = fleet metrics snapshot — ISSUE 13, routed to the
+learner's ``metrics_handler``) + 4 bytes big-endian payload length + 4
+bytes CRC32 of
 those first 5 header bytes + payload bytes + 4 bytes big-endian CRC32
 trailer (``serialize.frame_crc32`` over the payload; heartbeats have an
 empty payload). The header carries its own CRC because the two corruption
@@ -83,6 +85,10 @@ from dotaclient_tpu.utils import faults, telemetry, tracing
 _KIND_ROLLOUT = 0
 _KIND_WEIGHTS = 1
 _KIND_HEARTBEAT = 2
+# kinds 3/4 belong to the serve request/reply lane (serve/server.py —
+# its own listener, but the numbers stay disjoint so a misdirected
+# client is unambiguous in a packet capture)
+_KIND_METRICS = 5   # fleet-health snapshot, actor/serve → learner (ISSUE 13)
 _HEADER = struct.Struct(">BI")
 _CRC = struct.Struct(">I")
 # header-on-wire size: kind + length + CRC32 of those 5 bytes (see the
@@ -265,6 +271,11 @@ class TransportServer:
         # the first frame (no data = no compression claim)
         self._tel.gauge("transport/rollout_compression_ratio").set(1.0)
         self._rollout_totals = [0, 0]   # [wire bytes, raw bytes] consumed
+        # Fleet-health snapshot sink (ISSUE 13): the learner's
+        # FleetAggregator assigns its `ingest` here; reader threads hand
+        # it every CRC-verified kind-5 payload. None = frames dropped
+        # (a fleet-less consumer owes the peers nothing).
+        self.metrics_handler = None
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="transport-accept", daemon=True
         )
@@ -357,6 +368,7 @@ class TransportServer:
                 conn.last_seen = time.monotonic()  # any inbound bytes = alive
                 acc += recv_view[:n]
                 frames: List[bytes] = []
+                metrics: List[bytes] = []
                 off = 0
                 # memoryview slices are zero-copy, so bytes() is the ONE
                 # copy per frame (slicing the bytearray directly would
@@ -389,6 +401,16 @@ class TransportServer:
                             frames.append(
                                 bytes(acc_view[start:start + length])
                             )
+                        elif (
+                            kind == _KIND_METRICS
+                            and self.metrics_handler is not None
+                        ):
+                            # fleet snapshot (ISSUE 13): same CRC/streak
+                            # discipline as every frame above; handed to
+                            # the aggregator OUTSIDE the view's lifetime
+                            metrics.append(
+                                bytes(acc_view[start:start + length])
+                            )
                         # weights/heartbeat kinds from an actor are liveness
                         # traffic only (the echo path) — nothing to enqueue
                 finally:
@@ -397,6 +419,13 @@ class TransportServer:
                     del acc[:off]
                 if frames:
                     self._enqueue_rollouts(frames)
+                if metrics:
+                    handler = self.metrics_handler
+                    for m in metrics:
+                        try:
+                            handler(m)   # stamps its own receive time
+                        except Exception:  # noqa: BLE001
+                            pass   # a broken sink must never kill a reader
         except (OSError, ValueError):
             pass  # dead/poisoned actor: stateless, drop it (SURVEY.md §5.3)
         finally:
@@ -788,6 +817,15 @@ class SocketTransport:
                 self._sock.close()  # next send raises → reconnect machinery
         with self._send_lock:
             _send_frame(self._sock, _KIND_ROLLOUT, payload, crc=crc)
+
+    def publish_metrics_bytes(self, payload) -> None:
+        """Ship one fleet-health snapshot frame (kind 5, ISSUE 13) — the
+        same CRC'd framing as rollouts, so the learner's quarantine
+        discipline covers it unchanged. Raises like a rollout publish
+        when the connection is gone (the caller's reconnect machinery)."""
+        self._check()
+        with self._send_lock:
+            _send_frame(self._sock, _KIND_METRICS, payload)
 
     def consume_rollouts(
         self, max_count: int, timeout: Optional[float] = None

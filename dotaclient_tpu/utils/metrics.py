@@ -72,6 +72,23 @@ class MetricsLogger:
                 sink.emit(step, flat)
         return flat
 
+    def emit_event(self, event: Dict[str, object]) -> None:
+        """Broadcast one structured event (the ``ALERT`` channel, ISSUE
+        13) to every sink that speaks events (the JSONL sink's
+        flush-per-emit line), plus one greppable console line — the
+        chaos harness and operators both read it. Thread-safe: called
+        from the fleet aggregator's thread."""
+        import json
+
+        for sink in self._sinks:
+            fn = getattr(sink, "emit_event", None)
+            if fn is not None:
+                fn(event)
+        if self.console:
+            print(
+                f"ALERT {json.dumps(event, sort_keys=True)}", flush=True
+            )
+
     def close(self) -> None:
         for sink in self._sinks:
             sink.close()

@@ -81,7 +81,7 @@ import math
 import os
 import threading
 import time
-from typing import Dict, Iterator, List, Optional, TextIO
+from typing import Dict, Iterator, List, Optional, TextIO, Tuple
 
 __all__ = [
     "Counter",
@@ -104,6 +104,15 @@ __all__ = [
 # (``tracing.get() is None`` is the whole hot-path cost). ``--trace-sample``
 # overrides per process.
 trace_sample_n = 16
+
+# Fleet-health snapshot cadence (ISSUE 13; utils/fleet.py): actors and
+# serve processes push one compact metric snapshot (counter totals + gauge
+# values) upstream every this many seconds, and the learner-side
+# FleetAggregator merges/evaluates at the same cadence. <= 0 disables the
+# fanout (the aggregator's keys stay eager-created so schema tiers hold);
+# ``--fleet-interval`` overrides per process. A peer silent for several
+# intervals is itself a signal (``fleet/peers_stale``).
+fleet_interval_s = 5.0
 
 
 class Counter:
@@ -286,6 +295,19 @@ class Registry:
                 out[f"{name}/{stat}"] = v
         return out
 
+    def counters_and_gauges(self) -> "Tuple[Dict[str, float], Dict[str, float]]":
+        """Current counter totals and gauge values as two plain dicts —
+        the fleet-health snapshot source (ISSUE 13; utils/fleet.py). Kept
+        separate because the two kinds merge differently downstream:
+        counters are delta-merged (a restarted pid must not double-count),
+        gauges are last-write-wins. Timers are excluded — their stat
+        leaves are derived, not mergeable."""
+        with self._lock:
+            return (
+                {n: c.value for n, c in self._counters.items()},
+                {n: g.value for n, g in self._gauges.items()},
+            )
+
     def clear(self) -> None:
         """Drop every metric (test isolation)."""
         with self._lock:
@@ -355,6 +377,21 @@ class JsonlSink:
             },
             sort_keys=True,
         )
+        self._write_line(line)
+
+    def emit_event(self, event: Dict[str, object]) -> None:
+        """Append one structured event line (``{"ts", "event", ...}``) to
+        the same stream as the metrics envelopes — the alert channel
+        (ISSUE 13). Rides the SAME durability contract as :meth:`emit`
+        (one write of a complete line + flush), so a SIGKILL'd learner's
+        last ``ALERT`` events survive for the post-mortem. Readers
+        (``scripts/check_telemetry_schema.py``, ``scripts/fleet_status.py``)
+        dispatch on the ``event`` key."""
+        self._write_line(
+            json.dumps({"ts": time.time(), **event}, sort_keys=True)
+        )
+
+    def _write_line(self, line: str) -> None:
         with self._lock:
             if self._f is None:
                 return

@@ -84,6 +84,7 @@ class Supervisor:
         self.ckpt_dir = os.path.join(self.workdir, "ckpt")
         self.actors: List[Optional[subprocess.Popen]] = [None] * args.actors
         self.learner: Optional[subprocess.Popen] = None
+        self.actor_extra: List[str] = []   # per-scenario extra actor flags
         self.actor_restarts = 0
         self.actor_kills = 0
         self.shutting_down = False
@@ -177,16 +178,21 @@ class Supervisor:
                 "--trace-jsonl",
                 os.path.join(self.workdir, f"actor{i}.trace.jsonl"),
                 "--trace-sample", "1",
+                *self.actor_extra,
             ],
             cwd=REPO, env=env, stdout=log, stderr=subprocess.STDOUT,
         )
 
-    def _tend_actors(self) -> None:
+    def _tend_actors(self, skip: tuple = ()) -> None:
         """The restart policy: a dead actor (transport loss exit, our own
-        SIGKILL, ...) is relaunched — exactly what k8s would do."""
+        SIGKILL, ...) is relaunched — exactly what k8s would do. ``skip``
+        holds an index down deliberately (the alerts scenario keeps its
+        victim dead until the staleness alert fires)."""
         if self.shutting_down:
             return
         for i, p in enumerate(self.actors):
+            if i in skip:
+                continue
             if p is None or p.poll() is not None:
                 if p is not None:
                     self.actor_restarts += 1
@@ -492,6 +498,175 @@ class Supervisor:
             )
         return summary
 
+    def _alert_events(self, jsonl: str) -> List[Dict]:
+        """ALERT event lines of a (possibly live) metrics JSONL, in file
+        order — the alert engine's flush-per-emit durability is what
+        makes polling this mid-run sound."""
+        out = []
+        for rec in _jsonl_scalars(jsonl):
+            if rec.get("event") == "ALERT":
+                out.append(rec)
+        return out
+
+    def _wait_alert(
+        self,
+        learner: subprocess.Popen,
+        jsonl: str,
+        rule: str,
+        state: str,
+        after_ts: float = 0.0,
+        skip: tuple = (),
+    ) -> Dict:
+        """Poll the learner's metrics stream until an ALERT event for
+        ``rule`` in ``state`` (newer than ``after_ts``) appears; tends the
+        non-skipped actors meanwhile. A learner death fails the run."""
+        while True:
+            self._check_deadline()
+            self._tend_actors(skip=skip)
+            for ev in self._alert_events(jsonl):
+                if (
+                    ev.get("rule") == rule
+                    and ev.get("state") == state
+                    and ev.get("ts", 0.0) > after_ts
+                ):
+                    return ev
+            if learner.poll() is not None:
+                raise RuntimeError(
+                    f"learner exited rc={learner.returncode} before the "
+                    f"{rule!r} alert reached state {state!r} — see its log "
+                    f"in {self.workdir}"
+                )
+            time.sleep(0.5)
+
+    def run_alerts(self) -> Dict:
+        """ISSUE 13 acceptance scenario — the alert engine's
+        test-in-anger. A real learner + N actor fleet over the socket
+        lane at a fast fleet cadence; the plan kills an actor and holds
+        it down, asserts the ``fleet_peer_stale`` alert FIRES with its
+        runbook anchor, restarts the actor and asserts the alert
+        RESOLVES; actor 0 injects corrupt frames from the start, and the
+        ``corrupt_frame_rate`` integrity alert must fire too. PASS also
+        requires the learner to drain cleanly on SIGTERM with
+        ``alerts/fired_total`` >= 2 in its final metrics line."""
+        a = self.args
+        summary: Dict = {"scenario": "alerts", "seed": a.seed, "port": self.port}
+        jsonl = os.path.join(self.workdir, "learner1.jsonl")
+        interval = a.fleet_interval
+        self.actor_extra = ["--fleet-interval", str(interval)]
+        learner = self._spawn_learner(
+            1, restore=False, steps=10**6,
+            extra=["--fleet-interval", str(interval)],
+        )
+        self._tend_actors()
+
+        # 1) the fleet must assemble: every actor reporting snapshots
+        while True:
+            self._check_deadline()
+            self._tend_actors()
+            peers = 0.0
+            for rec in _jsonl_scalars(jsonl):
+                sc = rec.get("scalars")
+                if isinstance(sc, dict):
+                    peers = max(peers, sc.get("fleet/peers") or 0.0)
+            if peers >= a.actors:
+                break
+            if learner.poll() is not None:
+                summary["fail"] = (
+                    f"learner exited rc={learner.returncode} before the "
+                    f"fleet assembled"
+                )
+                return summary
+            time.sleep(0.5)
+        summary["fleet_peers_seen"] = peers
+
+        # 2) SIGKILL the victim and HOLD it down — silence is the signal
+        victim_idx = a.actors - 1
+        victim = self.actors[victim_idx]
+        if victim is not None and victim.poll() is None:
+            victim.kill()
+            self.actor_kills += 1
+            summary["killed_actor_pid"] = victim.pid
+
+        try:
+            fired = self._wait_alert(
+                learner, jsonl, "fleet_peer_stale", "fired",
+                skip=(victim_idx,),
+            )
+        except (TimeoutError, RuntimeError) as e:
+            summary["fail"] = f"staleness alert never fired: {e}"
+            return summary
+        summary["stale_alert_fired"] = {
+            "runbook": fired.get("runbook"),
+            "severity": fired.get("severity"),
+        }
+
+        # 3) restart the victim; the alert must RESOLVE once its fresh
+        # incarnation reports (same peer id: actors keep their seed)
+        self._tend_actors()
+        try:
+            resolved = self._wait_alert(
+                learner, jsonl, "fleet_peer_stale", "resolved",
+                after_ts=fired.get("ts", 0.0),
+            )
+        except (TimeoutError, RuntimeError) as e:
+            summary["fail"] = (
+                f"staleness alert did not resolve after restart: {e}"
+            )
+            return summary
+        summary["stale_alert_resolved_after_s"] = round(
+            resolved.get("ts", 0.0) - fired.get("ts", 0.0), 1
+        )
+
+        # 4) the integrity alert: actor 0 has been corrupting frames all
+        # along — the rate rule must have fired (or fire shortly)
+        try:
+            corrupt = self._wait_alert(
+                learner, jsonl, "corrupt_frame_rate", "fired"
+            )
+        except (TimeoutError, RuntimeError) as e:
+            summary["fail"] = f"integrity alert never fired: {e}"
+            return summary
+        summary["corrupt_alert_fired"] = {
+            "runbook": corrupt.get("runbook"),
+            "severity": corrupt.get("severity"),
+        }
+
+        # 5) drain: SIGTERM, clean exit, final counters
+        learner.send_signal(signal.SIGTERM)
+        rc = self._wait_exit(learner, "learner (alerts scenario drain)")
+        summary["learner_exit"] = rc
+        summary.update(self._stop_actors())
+        fired_total = 0.0
+        for rec in _jsonl_scalars(jsonl):
+            sc = rec.get("scalars")
+            if isinstance(sc, dict):
+                fired_total = max(
+                    fired_total, sc.get("alerts/fired_total") or 0.0
+                )
+        summary["alerts_fired_total"] = fired_total
+        summary["actor_restarts"] = self.actor_restarts
+
+        if rc != 0:
+            summary["fail"] = "learner did not drain cleanly on SIGTERM"
+        elif summary["stale_alert_fired"]["runbook"] != "rb:fleet-peer-stale":
+            summary["fail"] = (
+                f"staleness alert carries the wrong runbook anchor: "
+                f"{summary['stale_alert_fired']['runbook']!r}"
+            )
+        elif summary["corrupt_alert_fired"]["runbook"] != "rb:corrupt-frames":
+            summary["fail"] = (
+                f"integrity alert carries the wrong runbook anchor: "
+                f"{summary['corrupt_alert_fired']['runbook']!r}"
+            )
+        elif fired_total < 2:
+            summary["fail"] = (
+                f"alerts/fired_total never reached 2 in the metrics "
+                f"stream (saw {fired_total})"
+            )
+        elif self.actor_kills < 1:
+            summary["fail"] = "no actor was killed — the plan never ran"
+        return summary
+
     def cleanup(self) -> None:
         self.shutting_down = True
         # the learner too: a timed-out/failed plan must not orphan a live
@@ -520,12 +695,19 @@ def main(argv=None) -> int:
     p.add_argument("--corrupt-every", type=int, default=5,
                    help="actor 0 corrupts its corrupt-at'th frame and "
                    "every corrupt-every'th after")
-    p.add_argument("--scenario", choices=("baseline", "divergence"),
+    p.add_argument("--scenario", choices=("baseline", "divergence", "alerts"),
                    default="baseline",
                    help="baseline: kill/corrupt/SIGTERM/restore plan "
                    "(ISSUE 4); divergence: injected NaN gradient → "
                    "automatic last-good rollback, exact-target completion, "
-                   "poisoned versions never published (ISSUE 6)")
+                   "poisoned versions never published (ISSUE 6); alerts: "
+                   "actor kill → fleet_peer_stale alert fires with its "
+                   "runbook anchor and resolves on restart, injected "
+                   "corrupt frames → integrity alert (ISSUE 13)")
+    p.add_argument("--fleet-interval", type=float, default=0.5,
+                   help="alerts scenario: fleet snapshot/aggregation "
+                   "cadence in seconds (fast, so staleness detection and "
+                   "alert latency fit a CI-sized run)")
     p.add_argument("--divergence-steps", type=int, default=24,
                    help="divergence scenario: target optimizer steps the "
                    "run must complete to despite the rollback")
@@ -545,11 +727,12 @@ def main(argv=None) -> int:
         shutil.rmtree(args.workdir)
     sup = Supervisor(args)
     try:
-        summary = (
-            sup.run_divergence()
-            if args.scenario == "divergence"
-            else sup.run()
-        )
+        if args.scenario == "divergence":
+            summary = sup.run_divergence()
+        elif args.scenario == "alerts":
+            summary = sup.run_alerts()
+        else:
+            summary = sup.run()
     except (TimeoutError, RuntimeError) as e:
         summary = {"fail": str(e)}
     finally:

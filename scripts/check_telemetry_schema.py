@@ -216,6 +216,33 @@ TRACE_KEYS = (
     "mem/hbm_peak_bytes",           # device allocator peak (max over devices)
 )
 
+# Fleet health plane (ISSUE 13). Validated with --require-fleet against
+# ANY learner run's JSONL: the Learner constructs its FleetAggregator
+# unconditionally, which eager-creates every rollup/alert key at
+# construction — a run with no fleet traffic deterministically reports
+# zeros. Per-peer keys (fleet/<peer>/*) are dynamic and NOT in the tier.
+FLEET_KEYS = (
+    "fleet/peers",                  # peers reporting within the stale window
+    "fleet/peers_stale",            # peers gone silent (the page signal)
+    "fleet/snapshots_total",        # metric snapshot frames merged
+    "fleet/bad_snapshots_total",    # undecodable snapshot frames dropped
+    "fleet/agg/weight_staleness/min",
+    "fleet/agg/weight_staleness/max",
+    "fleet/agg/weight_staleness/mean",
+    "fleet/agg/env_fps/min",
+    "fleet/agg/env_fps/max",
+    "fleet/agg/env_fps/mean",
+    "fleet/agg/reconnects/min",
+    "fleet/agg/reconnects/max",
+    "fleet/agg/reconnects/mean",
+    "fleet/agg/corrupt_frames/min",
+    "fleet/agg/corrupt_frames/max",
+    "fleet/agg/corrupt_frames/mean",
+    "alerts/fired_total",           # alert rules that fired
+    "alerts/resolved_total",        # alerts that cleared
+    "alerts/active",                # rules firing right now
+)
+
 # Keys only an IN-PROCESS actor emits. A learner serving external actor
 # processes over socket/shm never runs its own collect loop, so its JSONL
 # legitimately lacks these — they are waived when the line union carries an
@@ -256,6 +283,11 @@ def validate_lines(
             continue
         if not isinstance(obj, dict):
             errors.append(f"line {i}: top level is {type(obj).__name__}, not object")
+            continue
+        if "event" in obj:
+            # the structured event channel (ALERT lines, ISSUE 13) rides
+            # the same file as the metrics envelopes; events are shaped
+            # by their emitter, not this schema — skip, don't fail
             continue
         if not isinstance(obj.get("ts"), (int, float)):
             errors.append(f"line {i}: missing/invalid 'ts'")
@@ -361,6 +393,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         "Learner eager-creates trace/compile/mem keys at construction",
     )
     p.add_argument(
+        "--require-fleet", action="store_true",
+        help="also require the fleet-health-plane keys (ISSUE 13); valid "
+        "against ANY learner run's JSONL — the Learner's FleetAggregator "
+        "eager-creates every rollup and alert key at construction",
+    )
+    p.add_argument(
         "--require-multichip", action="store_true",
         help="also require the multi-chip learner keys (ISSUE 10); valid "
         "against ANY learner run's JSONL at any device count — the "
@@ -387,6 +425,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         extra += MULTICHIP_KEYS
     if args.require_trace:
         extra += TRACE_KEYS
+    if args.require_fleet:
+        extra += FLEET_KEYS
 
     path = args.path
     if path is None:

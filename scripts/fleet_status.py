@@ -1,0 +1,249 @@
+"""Live run-status console: the fleet in one screen (ISSUE 13).
+
+Reads a learner's ``--metrics-jsonl`` stream — the metrics envelopes the
+FleetAggregator's merged ``fleet/`` keys ride, plus the structured
+``ALERT`` event lines the alert engine emits — and renders:
+
+* the **fleet table**: one row per reporting peer (actors ``aN``, serve
+  ``sN``) with env steps/sec, weight-refresh staleness, reconnects, and
+  corrupt frames, plus the min/max/mean rollups;
+* the **alert board**: every alert currently active (fired, not yet
+  resolved), with severity and its OPERATIONS.md runbook anchor;
+* a machine-readable ``FLEET_STATUS`` JSON line (the chaos harness and
+  CI read it).
+
+One-shot by default; ``--follow`` re-reads the (live) file at an
+interval — the tail a SIGKILL tears is dropped by the shared torn-line-
+tolerant reader, so pointing this at a crashed learner's log works too.
+
+Usage:
+    python scripts/fleet_status.py /tmp/run/learner.jsonl
+    python scripts/fleet_status.py /tmp/run/learner.jsonl --follow
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _light_load_jsonl():
+    """The torn-line-tolerant reader WITHOUT the package import chain
+    (utils/__init__ pulls jax + orbax — a status console must start in
+    milliseconds). Same loading discipline as check_telemetry_schema.py."""
+    mod = sys.modules.get("dotaclient_tpu.utils.telemetry")
+    if mod is None:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "_dota_telemetry_light",
+            os.path.join(_REPO, "dotaclient_tpu", "utils", "telemetry.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    return mod.load_jsonl
+
+
+load_jsonl = _light_load_jsonl()
+
+# fleet table columns: label → peer-side key suffix (under fleet/<peer>/)
+_COLUMNS = (
+    ("fps", "env_fps"),
+    ("staleness", "actor/weight_refresh_lag"),
+    ("reconnects", "transport/reconnects_total"),
+    ("corrupt", "transport/frames_corrupt_total"),
+    ("rollouts", "actor/rollouts_shipped"),
+    ("p99_ms", "serve/p99_latency_ms"),
+)
+_AGG_METRICS = ("weight_staleness", "env_fps", "reconnects", "corrupt_frames")
+_RESERVED_SEGMENTS = {"agg", "peers", "peers_stale", "snapshots_total",
+                      "bad_snapshots_total"}
+
+
+def parse_stream(
+    lines: List[str],
+) -> Tuple[Dict[str, float], List[dict], Optional[float], Optional[int]]:
+    """→ (latest scalar union, ALERT events in order, last ts, last step)."""
+    scalars: Dict[str, float] = {}
+    events: List[dict] = []
+    last_ts: Optional[float] = None
+    last_step: Optional[int] = None
+    for raw in lines:
+        try:
+            obj = json.loads(raw)
+        except json.JSONDecodeError:
+            continue
+        if not isinstance(obj, dict):
+            continue
+        if obj.get("event") == "ALERT":
+            events.append(obj)
+            continue
+        sc = obj.get("scalars")
+        if isinstance(sc, dict):
+            scalars.update(
+                {k: v for k, v in sc.items() if isinstance(v, (int, float))}
+            )
+            last_ts = obj.get("ts", last_ts)
+            last_step = obj.get("step", last_step)
+    return scalars, events, last_ts, last_step
+
+
+def active_alerts(events: List[dict]) -> List[dict]:
+    """Replay fired/resolved transitions; what remains is active NOW."""
+    active: Dict[str, dict] = {}
+    for ev in events:
+        rule = ev.get("rule")
+        if not isinstance(rule, str):
+            continue
+        if ev.get("state") == "fired":
+            active[rule] = ev
+        elif ev.get("state") == "resolved":
+            active.pop(rule, None)
+    return list(active.values())
+
+
+def fleet_peers(scalars: Dict[str, float]) -> List[str]:
+    peers = set()
+    for key in scalars:
+        if not key.startswith("fleet/"):
+            continue
+        seg = key.split("/", 2)[1]
+        if seg and seg not in _RESERVED_SEGMENTS:
+            peers.add(seg)
+    return sorted(peers)
+
+
+def _fmt(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return f"{v:.2f}"
+
+
+def render(
+    scalars: Dict[str, float],
+    events: List[dict],
+    last_ts: Optional[float],
+    last_step: Optional[int],
+) -> Tuple[str, dict]:
+    """→ (human-readable console text, FLEET_STATUS summary dict)."""
+    peers = fleet_peers(scalars)
+    actives = active_alerts(events)
+    lines: List[str] = []
+    age = f"{time.time() - last_ts:.0f}s ago" if last_ts else "n/a"
+    lines.append(
+        f"== fleet status @ step {last_step if last_step is not None else '?'}"
+        f" (last metrics line {age}) =="
+    )
+    n_live = scalars.get("fleet/peers", 0.0)
+    n_stale = scalars.get("fleet/peers_stale", 0.0)
+    lines.append(
+        f"peers: {int(n_live)} reporting, {int(n_stale)} stale | "
+        f"snapshots merged: {int(scalars.get('fleet/snapshots_total', 0))} "
+        f"(bad: {int(scalars.get('fleet/bad_snapshots_total', 0))})"
+    )
+    header = ["peer"] + [label for label, _ in _COLUMNS]
+    rows = [header]
+    for peer in peers:
+        row = [peer]
+        for _, suffix in _COLUMNS:
+            row.append(_fmt(scalars.get(f"fleet/{peer}/{suffix}")))
+        rows.append(row)
+    for stat in ("min", "max", "mean"):
+        row = [f"agg/{stat}"]
+        agg = {
+            "env_fps": scalars.get(f"fleet/agg/env_fps/{stat}"),
+            "actor/weight_refresh_lag": scalars.get(
+                f"fleet/agg/weight_staleness/{stat}"
+            ),
+            "transport/reconnects_total": scalars.get(
+                f"fleet/agg/reconnects/{stat}"
+            ),
+            "transport/frames_corrupt_total": scalars.get(
+                f"fleet/agg/corrupt_frames/{stat}"
+            ),
+        }
+        for _, suffix in _COLUMNS:
+            row.append(_fmt(agg.get(suffix)))
+        rows.append(row)
+    widths = [
+        max(len(r[c]) for r in rows) for c in range(len(header))
+    ]
+    for i, row in enumerate(rows):
+        lines.append(
+            "  ".join(cell.ljust(widths[c]) for c, cell in enumerate(row))
+        )
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    fired_total = scalars.get("alerts/fired_total", 0.0)
+    lines.append(
+        f"alerts: {len(actives)} active, {int(fired_total)} fired this run"
+    )
+    for ev in actives:
+        lines.append(
+            f"  [{ev.get('severity', '?').upper():4s}] {ev.get('rule')}: "
+            f"{ev.get('summary', '')} (runbook {ev.get('runbook')}, "
+            f"value {_fmt(ev.get('value'))} vs {_fmt(ev.get('threshold'))})"
+        )
+    summary = {
+        "step": last_step,
+        "peers": peers,
+        "n_peers": int(n_live),
+        "peers_stale": int(n_stale),
+        "snapshots_total": int(scalars.get("fleet/snapshots_total", 0)),
+        "active_alerts": [
+            {
+                "rule": ev.get("rule"),
+                "severity": ev.get("severity"),
+                "runbook": ev.get("runbook"),
+            }
+            for ev in actives
+        ],
+        "alerts_fired_total": int(fired_total),
+        "ok": n_stale == 0
+        and not any(ev.get("severity") == "page" for ev in actives),
+    }
+    return "\n".join(lines), summary
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("path", help="a learner's --metrics-jsonl file")
+    p.add_argument(
+        "--follow", action="store_true",
+        help="re-read and re-render at --interval until interrupted "
+        "(live console against a running learner)",
+    )
+    p.add_argument("--interval", type=float, default=2.0)
+    args = p.parse_args(argv)
+    while True:
+        try:
+            lines = load_jsonl(args.path)
+        except OSError as e:
+            print(f"fleet_status: cannot read {args.path}: {e}",
+                  file=sys.stderr)
+            return 1
+        text, summary = render(*parse_stream(lines))
+        print(text, flush=True)
+        print("FLEET_STATUS " + json.dumps(summary, sort_keys=True),
+              flush=True)
+        if not args.follow:
+            return 0
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+        print(flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -90,3 +90,41 @@ def test_chaos_divergence_scenario(tmp_path):
     assert summary["final_step"] == 24            # target reached exactly
     assert summary["leaked_versions"] == []       # poison never published
     assert any(summary["actor_versions_seen"])    # fanout really happened
+
+
+@pytest.mark.slow
+def test_chaos_alerts_scenario(tmp_path):
+    """ISSUE 13 acceptance: the alert engine's test-in-anger. A killed
+    actor's SILENCE fires the ``fleet_peer_stale`` alert with its
+    runbook anchor, the restarted incarnation RESOLVES it, the injected
+    corrupt frames fire the integrity alert, and the learner still
+    drains cleanly with ``alerts/fired_total`` >= 2 on record."""
+    env = dict(os.environ)
+    env.pop("DOTA_FAULTS", None)   # the supervisor sets per-child specs
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "scripts", "chaos_run.py"),
+            "--scenario", "alerts",
+            "--workdir", str(tmp_path / "chaos"),
+            "--seed", "0",
+            "--timeout", "900",
+        ],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=960,
+    )
+    summary_lines = [
+        line for line in proc.stdout.splitlines()
+        if line.startswith("CHAOS_SUMMARY ")
+    ]
+    assert summary_lines, (
+        f"no CHAOS_SUMMARY emitted\nstdout:\n{proc.stdout[-3000:]}\n"
+        f"stderr:\n{proc.stderr[-3000:]}"
+    )
+    summary = json.loads(summary_lines[-1][len("CHAOS_SUMMARY "):])
+    assert proc.returncode == 0 and summary.get("ok"), summary
+    assert summary["learner_exit"] == 0
+    assert summary["stale_alert_fired"]["runbook"] == "rb:fleet-peer-stale"
+    assert summary["stale_alert_fired"]["severity"] == "page"
+    assert summary["stale_alert_resolved_after_s"] > 0
+    assert summary["corrupt_alert_fired"]["runbook"] == "rb:corrupt-frames"
+    assert summary["alerts_fired_total"] >= 2
+    assert summary["fleet_peers_seen"] >= 2

@@ -1,0 +1,733 @@
+"""Fleet health plane tests (ISSUE 13): snapshot codec round-trips on
+both wire lanes (CRC/quarantine semantics unchanged for the new frame
+kind), counter-delta merge across peer restart, alert rule
+debounce/for-duration/resolve semantics, the rules↔runbook lint
+cross-check on a doctored OPERATIONS.md, the --require-fleet schema
+tier, and the fleet_status console on a canned JSONL."""
+
+import ast
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from dotaclient_tpu.utils import alerts, fleet, telemetry
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _schema_module():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_telemetry_schema",
+        os.path.join(_REPO, "scripts", "check_telemetry_schema.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fleet_status_module():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "fleet_status", os.path.join(_REPO, "scripts", "fleet_status.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_publisher():
+    """Every test starts and ends with the fleet fanout OFF (the
+    in-process default); a leaked publisher would change other tests'
+    pool hot paths."""
+    fleet.shutdown()
+    yield
+    fleet.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# snapshot codec
+
+
+class TestSnapshotCodec:
+    def test_round_trip(self):
+        payload = fleet.encode_snapshot(
+            7, "actor", 3,
+            {"actor/env_steps": 1234.0, "transport/reconnects_total": 2.0},
+            {"actor/weight_refresh_lag": 5.0},
+            pid=42,
+        )
+        snap = fleet.decode_snapshot(payload)
+        assert snap == {
+            "peer": "a7",
+            "kind": "actor",
+            "pid": 42,
+            "seq": 3,
+            "counters": {
+                "actor/env_steps": 1234.0,
+                "transport/reconnects_total": 2.0,
+            },
+            "gauges": {"actor/weight_refresh_lag": 5.0},
+        }
+
+    def test_serve_kind_and_filtering(self):
+        payload = fleet.encode_snapshot(
+            9, "serve", 0,
+            # span keys and foreign namespaces must NOT ship
+            {"serve/requests_total": 10.0, "span/not/shipped": 1.0,
+             "league/eval_win": 1.0},
+            {"serve/p99_latency_ms": 12.5},
+        )
+        snap = fleet.decode_snapshot(payload)
+        assert snap["peer"] == "s9"
+        assert snap["kind"] == "serve"
+        assert snap["counters"] == {"serve/requests_total": 10.0}
+        assert snap["gauges"] == {"serve/p99_latency_ms": 12.5}
+
+    def test_garbage_decodes_to_none(self):
+        assert fleet.decode_snapshot(b"not a frame") is None
+
+
+# ---------------------------------------------------------------------------
+# both wire lanes
+
+
+class TestSocketLane:
+    def test_snapshot_rides_kind5_and_rollouts_unaffected(self):
+        from dotaclient_tpu.transport.socket_transport import (
+            SocketTransport,
+            TransportServer,
+        )
+        from dotaclient_tpu.transport.serialize import encode_rollout_bytes
+
+        server = TransportServer(port=0)
+        received = []
+        server.metrics_handler = lambda p: received.append(
+            fleet.decode_snapshot(p)
+        )
+        host, port = server.address
+        actor = None
+        try:
+            actor = SocketTransport(host, port)
+            actor.publish_metrics_bytes(
+                fleet.encode_snapshot(1, "actor", 0, {"actor/env_steps": 8.0}, {})
+            )
+            actor.publish_rollout_bytes(
+                bytes(
+                    encode_rollout_bytes(
+                        {"rewards": np.zeros(4, np.float32)},
+                        model_version=0, env_id=0, rollout_id=0, length=4,
+                        total_reward=0.0,
+                    )
+                )
+            )
+            deadline = time.time() + 5.0
+            rollouts = []
+            while time.time() < deadline and (not received or not rollouts):
+                rollouts += server.consume_decoded(16, timeout=0.1)
+            assert received and received[0]["peer"] == "a1"
+            assert received[0]["counters"] == {"actor/env_steps": 8.0}
+            assert len(rollouts) == 1   # the metrics frame never reaches
+            # the experience path
+        finally:
+            if actor is not None:
+                actor.close()
+            server.close()
+
+    def test_corrupt_metrics_frame_counts_and_streaks(self):
+        """CRC/quarantine semantics are UNCHANGED for the new kind: a
+        corrupt metrics frame is dropped + counted and advances the
+        poison streak exactly like a corrupt rollout."""
+        from dotaclient_tpu.transport.socket_transport import (
+            _KIND_METRICS,
+            SocketTransport,
+            TransportServer,
+            _send_frame,
+        )
+
+        tel = telemetry.get_registry()
+        server = TransportServer(port=0, poison_frame_limit=2)
+        received = []
+        server.metrics_handler = lambda p: received.append(p)
+        host, port = server.address
+        actor = None
+        try:
+            before = tel.counter("transport/frames_corrupt_total").value
+            q_before = tel.counter("transport/peers_quarantined").value
+            actor = SocketTransport(host, port)
+            payload = fleet.encode_snapshot(1, "actor", 0, {}, {})
+            _send_frame(actor._sock, _KIND_METRICS, payload, crc=0xBAD)
+            _send_frame(actor._sock, _KIND_METRICS, payload, crc=0xBAD)
+            deadline = time.time() + 5.0
+            while (
+                time.time() < deadline
+                and tel.counter("transport/peers_quarantined").value
+                <= q_before
+            ):
+                time.sleep(0.05)
+            assert (
+                tel.counter("transport/frames_corrupt_total").value
+                >= before + 2
+            )
+            assert (
+                tel.counter("transport/peers_quarantined").value
+                == q_before + 1
+            )
+            assert received == []   # corrupt frames never reach the sink
+        finally:
+            if actor is not None:
+                actor.close()
+            server.close()
+
+
+class TestShmLane:
+    def _lane(self, tag, **kw):
+        from dotaclient_tpu.transport import ShmTransport, ShmTransportServer
+
+        name = f"t-fleet-{os.getpid()}-{tag}"
+        server = ShmTransportServer(
+            name=name, slots=1, ring_bytes=1 << 16, weights_bytes=1 << 16,
+            **kw,
+        )
+        actor = ShmTransport(name, slots=1)
+        return server, actor
+
+    def test_flag_bit_routes_to_handler(self):
+        from dotaclient_tpu.transport.serialize import encode_rollout_bytes
+
+        server, actor = self._lane("route")
+        received = []
+        server.metrics_handler = lambda p: received.append(
+            fleet.decode_snapshot(p)
+        )
+        try:
+            actor.publish_metrics_bytes(
+                fleet.encode_snapshot(2, "actor", 1, {"actor/env_steps": 4.0}, {})
+            )
+            actor.publish_rollout_bytes(
+                bytes(
+                    encode_rollout_bytes(
+                        {"rewards": np.zeros(4, np.float32)},
+                        model_version=0, env_id=0, rollout_id=9, length=4,
+                        total_reward=0.0,
+                    )
+                )
+            )
+            rollouts = server.consume_decoded(16, timeout=1.0)
+            assert received and received[0]["peer"] == "a2"
+            assert received[0]["seq"] == 1
+            # the rollout still flows; the metrics frame never mixes in
+            assert len(rollouts) == 1
+            assert rollouts[0][0]["rollout_id"] == 9
+        finally:
+            actor.close()
+            server.close()
+
+    def test_corrupt_metrics_frame_streaks_to_quarantine(self):
+        from dotaclient_tpu.utils import faults
+
+        tel = telemetry.get_registry()
+        before = tel.counter("transport/frames_corrupt_total").value
+        q_before = tel.counter("transport/peers_quarantined").value
+        # every publish corrupts: the metrics path routes through the
+        # same fault site as rollouts (shared framing by construction)
+        faults.configure("transport.corrupt_frame@1+1")
+        try:
+            server, actor = self._lane("poison", poison_frame_limit=2)
+            received = []
+            server.metrics_handler = lambda p: received.append(p)
+            try:
+                actor.publish_metrics_bytes(
+                    fleet.encode_snapshot(0, "actor", 0, {}, {})
+                )
+                actor.publish_metrics_bytes(
+                    fleet.encode_snapshot(0, "actor", 1, {}, {})
+                )
+                assert server.consume_decoded(16, timeout=0.2) == []
+                assert received == []
+                assert (
+                    tel.counter("transport/frames_corrupt_total").value
+                    >= before + 2
+                )
+                assert (
+                    tel.counter("transport/peers_quarantined").value
+                    == q_before + 1
+                )
+            finally:
+                actor.close()
+                server.close()
+        finally:
+            faults.configure(None)
+
+
+# ---------------------------------------------------------------------------
+# aggregator merge semantics
+
+
+class TestAggregatorMerge:
+    def _agg(self, **kw):
+        reg = telemetry.Registry()
+        events = []
+        agg = fleet.FleetAggregator(
+            registry=reg, interval_s=0.1, emit_event=events.append, **kw
+        )
+        return reg, agg, events
+
+    def test_counter_delta_merge_across_restart(self):
+        """The acceptance pin: a restarted pid must not double-count. The
+        old incarnation folded 150 cumulative steps in; the fresh pid's
+        cumulative counter restarts from 0, so its first snapshot ADDS
+        its own total instead of re-adding history."""
+        reg, agg, _ = self._agg()
+        t = time.monotonic()
+        agg.ingest(fleet.encode_snapshot(
+            0, "actor", 0, {"actor/env_steps": 100.0}, {}, pid=111))
+        agg.ingest(fleet.encode_snapshot(
+            0, "actor", 1, {"actor/env_steps": 150.0}, {}, pid=111))
+        agg.tick(now=t)
+        assert reg.snapshot()["fleet/a0/actor/env_steps"] == 150.0
+        # restart: same peer id (seed), fresh pid, counters from zero
+        agg.ingest(fleet.encode_snapshot(
+            0, "actor", 0, {"actor/env_steps": 30.0}, {}, pid=222))
+        agg.tick(now=t + 0.1)
+        assert reg.snapshot()["fleet/a0/actor/env_steps"] == 180.0
+        # and only ONE peer row exists (the restart reused it)
+        assert reg.snapshot()["fleet/peers"] == 1.0
+
+    def test_lost_frame_loses_nothing(self):
+        """Receiver-side deltas over cumulative totals: a dropped
+        snapshot's increment arrives with the next one."""
+        reg, agg, _ = self._agg()
+        agg.ingest(fleet.encode_snapshot(
+            0, "actor", 0, {"actor/env_steps": 10.0}, {}, pid=1))
+        # seq 1 lost; seq 2 carries the cumulative total
+        agg.ingest(fleet.encode_snapshot(
+            0, "actor", 2, {"actor/env_steps": 50.0}, {}, pid=1))
+        agg.tick()
+        assert reg.snapshot()["fleet/a0/actor/env_steps"] == 50.0
+
+    def test_rollups_and_stale_peers(self):
+        reg, agg, _ = self._agg(stale_after_s=0.5)
+        t = time.monotonic()
+        for peer, lag in ((0, 2.0), (1, 6.0)):
+            agg.ingest(fleet.encode_snapshot(
+                peer, "actor", 0, {}, {"actor/weight_refresh_lag": lag},
+                pid=peer + 1,
+            ))
+        agg.tick(now=t)
+        snap = reg.snapshot()
+        assert snap["fleet/peers"] == 2.0
+        assert snap["fleet/peers_stale"] == 0.0
+        assert snap["fleet/agg/weight_staleness/min"] == 2.0
+        assert snap["fleet/agg/weight_staleness/max"] == 6.0
+        assert snap["fleet/agg/weight_staleness/mean"] == 4.0
+        # silence: both peers stop reporting past the stale window
+        agg.tick(now=t + 1.0)
+        snap = reg.snapshot()
+        assert snap["fleet/peers"] == 0.0
+        assert snap["fleet/peers_stale"] == 2.0
+        # rollups over an empty live set read 0, never stale values
+        assert snap["fleet/agg/weight_staleness/max"] == 0.0
+
+    def test_env_fps_rate(self):
+        reg, agg, _ = self._agg()
+        t = time.monotonic()
+        agg.ingest(
+            fleet.encode_snapshot(
+                0, "actor", 0, {"actor/env_steps": 0.0}, {}, pid=1
+            ),
+            recv_ts=t,
+        )
+        agg.ingest(
+            fleet.encode_snapshot(
+                0, "actor", 1, {"actor/env_steps": 100.0}, {}, pid=1
+            ),
+            recv_ts=t + 2.0,
+        )
+        agg.tick(now=t + 2.0)
+        assert reg.snapshot()["fleet/a0/env_fps"] == pytest.approx(50.0)
+
+    def test_bad_snapshot_counted_not_raised(self):
+        reg, agg, _ = self._agg()
+        assert agg.ingest(b"\x00\x01garbage") is False
+        assert reg.snapshot()["fleet/bad_snapshots_total"] == 1.0
+
+    def test_eager_keys_at_construction(self):
+        reg = telemetry.Registry()
+        fleet.FleetAggregator(registry=reg)
+        snap = reg.snapshot()
+        for key in (
+            "fleet/peers", "fleet/peers_stale", "fleet/snapshots_total",
+            "fleet/bad_snapshots_total", "alerts/fired_total",
+            "alerts/resolved_total", "alerts/active",
+        ):
+            assert key in snap, key
+        for name in fleet.AGG_KEYS:
+            assert f"fleet/agg/{name}" in snap
+
+
+# ---------------------------------------------------------------------------
+# alert engine semantics
+
+
+def _rule(**kw):
+    base = dict(
+        name="r", key="x", kind="threshold", value=5.0, runbook="rb:x"
+    )
+    base.update(kw)
+    return alerts.AlertRule(**base)
+
+
+class TestAlertEngine:
+    def _engine(self, rule):
+        reg = telemetry.Registry()
+        events = []
+        eng = alerts.AlertEngine(
+            rules=(rule,), registry=reg, emit=events.append
+        )
+        return reg, eng, events
+
+    def test_threshold_for_duration_debounce(self):
+        reg, eng, events = self._engine(_rule(for_s=10.0))
+        assert eng.evaluate({"x": 9.0}, now=0.0) == ([], [])
+        assert eng.evaluate({"x": 9.0}, now=5.0) == ([], [])   # pending
+        fired, _ = eng.evaluate({"x": 9.0}, now=10.0)
+        assert fired == ["r"]
+        assert reg.snapshot()["alerts/active"] == 1.0
+        assert events[-1]["state"] == "fired"
+        assert events[-1]["runbook"] == "rb:x"
+        # a dip resets the debounce clock entirely
+        eng2 = self._engine(_rule(for_s=10.0))[1]
+        eng2.evaluate({"x": 9.0}, now=0.0)
+        eng2.evaluate({"x": 1.0}, now=5.0)    # condition clears
+        assert eng2.evaluate({"x": 9.0}, now=12.0) == ([], [])  # re-arms
+
+    def test_resolve_and_counters(self):
+        reg, eng, events = self._engine(_rule())
+        eng.evaluate({"x": 9.0}, now=0.0)
+        _, resolved = eng.evaluate({"x": 1.0}, now=1.0)
+        assert resolved == ["r"]
+        snap = reg.snapshot()
+        assert snap["alerts/fired_total"] == 1.0
+        assert snap["alerts/resolved_total"] == 1.0
+        assert snap["alerts/active"] == 0.0
+        assert [e["state"] for e in events] == ["fired", "resolved"]
+
+    def test_rate_rule_window(self):
+        reg, eng, _ = self._engine(
+            _rule(kind="rate", value=1.0, window_s=10.0)
+        )
+        assert eng.evaluate({"x": 0.0}, now=0.0) == ([], [])
+        # 5 per second: over the 1/s bound
+        fired, _ = eng.evaluate({"x": 50.0}, now=10.0)
+        assert fired == ["r"]
+        # plateau: rate decays to zero inside the window → resolves
+        _, resolved = eng.evaluate({"x": 50.0}, now=25.0)
+        assert resolved == ["r"]
+
+    def test_rate_counter_reset_restarts_window(self):
+        _, eng, _ = self._engine(_rule(kind="rate", value=0.0, window_s=60.0))
+        eng.evaluate({"x": 100.0}, now=0.0)
+        # process restart: the counter fell — must NOT read as negative
+        # rate nor as a giant positive one
+        assert eng.evaluate({"x": 1.0}, now=1.0) == ([], [])
+
+    def test_stale_rule(self):
+        _, eng, _ = self._engine(_rule(kind="stale", value=5.0))
+        assert eng.evaluate({"x": 3.0}, now=0.0) == ([], [])
+        assert eng.evaluate({"x": 3.0}, now=4.0) == ([], [])
+        fired, _ = eng.evaluate({"x": 3.0}, now=6.0)
+        assert fired == ["r"]
+        # the value moving again resolves it
+        _, resolved = eng.evaluate({"x": 4.0}, now=7.0)
+        assert resolved == ["r"]
+
+    def test_pattern_key_aggregation(self):
+        _, eng, _ = self._engine(
+            _rule(key="fleet/*/serve/p99_latency_ms", value=100.0, agg="max")
+        )
+        fired, _ = eng.evaluate(
+            {
+                "fleet/s1/serve/p99_latency_ms": 50.0,
+                "fleet/s2/serve/p99_latency_ms": 150.0,
+            },
+            now=0.0,
+        )
+        assert fired == ["r"]
+
+    def test_missing_key_is_silent(self):
+        _, eng, _ = self._engine(_rule())
+        assert eng.evaluate({}, now=0.0) == ([], [])
+        assert eng.evaluate({}, now=100.0) == ([], [])
+
+    def test_runbook_anchor_mandatory(self):
+        with pytest.raises(ValueError, match="runbook"):
+            alerts.AlertEngine(
+                rules=(_rule(runbook=""),), registry=telemetry.Registry()
+            )
+
+    def test_shipped_rules_construct(self):
+        eng = alerts.AlertEngine(registry=telemetry.Registry())
+        assert len(eng.rules) >= 10
+        eng.evaluate({}, now=0.0)   # no data anywhere: no rule fires
+        assert eng.active_rules() == []
+
+
+# ---------------------------------------------------------------------------
+# rules ↔ runbook cross-check (the alert-drift lint pass)
+
+
+class TestAlertDrift:
+    def _inputs(self):
+        from dotaclient_tpu.lint import alert_drift as ad
+
+        alerts_src = open(
+            os.path.join(_REPO, "dotaclient_tpu", "utils", "alerts.py")
+        ).read()
+        doc = open(os.path.join(_REPO, "docs", "OPERATIONS.md")).read()
+        tree = ast.parse(alerts_src)
+        rules, problems = ad.extract_rules(tree)
+        assert problems == []
+        waivers = ad.extract_waivers(tree)
+        return ad, rules, waivers, doc
+
+    def test_clean_on_head(self):
+        ad, rules, waivers, doc = self._inputs()
+        assert len(rules) >= 10, "the shipped rule table extracted"
+        assert waivers, "the waiver list extracted"
+        assert ad.drift_findings(rules, waivers, doc) == []
+
+    def test_deleted_runbook_anchor_fails(self):
+        """The acceptance pin: doctor the REAL OPERATIONS.md by deleting
+        one anchor token — the rule pointing at it must flag, and the
+        now-anchorless row must flag too."""
+        ad, rules, waivers, doc = self._inputs()
+        assert "`rb:staleness-spike`" in doc
+        doctored = doc.replace("`rb:staleness-spike`", "", 1)
+        findings = ad.drift_findings(rules, waivers, doctored)
+        msgs = [f.message for f in findings]
+        assert any(
+            "rb:staleness-spike" in m and "does not exist" in m for m in msgs
+        ), msgs
+        assert any("carries no `rb:<anchor>`" in m for m in msgs)
+
+    def test_unwatched_failure_mode_fails(self):
+        """A new runbook row with an anchor but neither rule nor waiver
+        must flag — documenting a failure mode forces the decision."""
+        ad, rules, waivers, doc = self._inputs()
+        doctored = doc.replace(
+            "| failure | detection signal (telemetry) | automatic response | operator action |",
+            "| failure | detection signal (telemetry) | automatic response | operator action |\n"
+            "|---|---|---|---|\n"
+            "| made-up failure `rb:made-up` | a key | nothing | read this |",
+            1,
+        )
+        findings = ad.drift_findings(rules, waivers, doctored)
+        assert any(
+            f.context == "rb:made-up" and "neither an alert rule" in f.message
+            for f in findings
+        )
+
+    def test_stale_waiver_fails(self):
+        ad, rules, waivers, doc = self._inputs()
+        with_ghost = {**waivers, "rb:does-not-exist": "why"}
+        findings = ad.drift_findings(rules, with_ghost, doc)
+        assert any(f.context == "rb:does-not-exist" for f in findings)
+        # a waiver covering a RULED anchor is stale the other way
+        with_covered = {**waivers, "rb:staleness-spike": "why"}
+        findings2 = ad.drift_findings(rules, with_covered, doc)
+        assert any("a rule now covers it" in f.message for f in findings2)
+
+    def test_catalog_mirrors_rules(self):
+        ad, rules, waivers, doc = self._inputs()
+        # drop one catalog row → the rule must flag as uncatalogued
+        doctored = "\n".join(
+            l for l in doc.splitlines()
+            if not l.startswith("| `weight_staleness_high`")
+        )
+        findings = ad.drift_findings(rules, waivers, doctored)
+        assert any(
+            f.context == "weight_staleness_high"
+            and "no row in the" in f.message
+            for f in findings
+        )
+
+
+# ---------------------------------------------------------------------------
+# schema tier + console
+
+
+class TestSchemaTier:
+    def test_require_fleet_round_trip(self):
+        schema = _schema_module()
+        reg = telemetry.Registry()
+        fleet.FleetAggregator(registry=reg)   # eager keys, thread not started
+        scalars = dict(reg.snapshot())
+        line = json.dumps({"ts": 1.0, "step": 0, "scalars": scalars})
+        errs = schema.validate_lines(
+            [line], extra_required=schema.FLEET_KEYS, base_required=()
+        )
+        assert errs == []
+        scalars.pop("fleet/peers_stale")
+        line = json.dumps({"ts": 1.0, "step": 0, "scalars": scalars})
+        errs = schema.validate_lines(
+            [line], extra_required=schema.FLEET_KEYS, base_required=()
+        )
+        assert any("fleet/peers_stale" in e for e in errs)
+
+    def test_alert_event_lines_are_tolerated(self):
+        """ALERT events ride the same JSONL; the envelope validator must
+        skip them, never fail them."""
+        schema = _schema_module()
+        reg = telemetry.Registry()
+        fleet.FleetAggregator(registry=reg)
+        lines = [
+            json.dumps({"ts": 1.0, "event": "ALERT", "state": "fired",
+                        "rule": "x", "runbook": "rb:x"}),
+            json.dumps({"ts": 2.0, "step": 0, "scalars": dict(reg.snapshot())}),
+        ]
+        errs = schema.validate_lines(
+            lines, extra_required=schema.FLEET_KEYS, base_required=()
+        )
+        assert errs == []
+
+    def test_fleet_keys_match_aggregator(self):
+        """The tier list and the aggregator's eager key set cannot
+        drift: every tier key must exist at bare construction."""
+        schema = _schema_module()
+        reg = telemetry.Registry()
+        fleet.FleetAggregator(registry=reg)
+        snap = reg.snapshot()
+        for key in schema.FLEET_KEYS:
+            assert key in snap, key
+
+
+class TestFleetStatus:
+    def _canned(self, tmp_path):
+        reg = telemetry.Registry()
+        agg = fleet.FleetAggregator(registry=reg, interval_s=0.1)
+        t = time.monotonic()
+        agg.ingest(fleet.encode_snapshot(
+            0, "actor", 0,
+            {"actor/env_steps": 500.0, "transport/reconnects_total": 1.0},
+            {"actor/weight_refresh_lag": 2.0}, pid=11), recv_ts=t)
+        agg.ingest(fleet.encode_snapshot(
+            1, "actor", 0, {"actor/env_steps": 300.0},
+            {"actor/weight_refresh_lag": 4.0}, pid=12), recv_ts=t)
+        agg.tick(now=t)
+        path = tmp_path / "learner.jsonl"
+        sink = telemetry.JsonlSink(str(path))
+        sink.emit_event({"event": "ALERT", "state": "fired",
+                         "rule": "corrupt_frame_rate", "severity": "warn",
+                         "runbook": "rb:corrupt-frames", "value": 1.0,
+                         "threshold": 0.02, "summary": "s"})
+        sink.emit_event({"event": "ALERT", "state": "fired",
+                         "rule": "fleet_peer_stale", "severity": "page",
+                         "runbook": "rb:fleet-peer-stale", "value": 1.0,
+                         "threshold": 0.0, "summary": "s"})
+        sink.emit_event({"event": "ALERT", "state": "resolved",
+                         "rule": "fleet_peer_stale", "severity": "page",
+                         "runbook": "rb:fleet-peer-stale", "value": 0.0,
+                         "threshold": 0.0, "summary": "s"})
+        sink.emit(7, reg.snapshot())
+        sink.close()
+        return path
+
+    def test_one_shot_render_and_summary(self, tmp_path, capsys):
+        fs = _fleet_status_module()
+        path = self._canned(tmp_path)
+        assert fs.main([str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "a0" in out and "a1" in out
+        status_lines = [
+            l for l in out.splitlines() if l.startswith("FLEET_STATUS ")
+        ]
+        assert len(status_lines) == 1
+        summary = json.loads(status_lines[0][len("FLEET_STATUS "):])
+        assert summary["peers"] == ["a0", "a1"]
+        assert summary["n_peers"] == 2
+        assert summary["peers_stale"] == 0
+        # resolved alerts are NOT active; the corrupt one still is
+        assert [a["rule"] for a in summary["active_alerts"]] == [
+            "corrupt_frame_rate"
+        ]
+        assert summary["active_alerts"][0]["runbook"] == "rb:corrupt-frames"
+        assert summary["ok"] is True   # no stale peers, no active page
+
+    def test_torn_tail_tolerated(self, tmp_path, capsys):
+        fs = _fleet_status_module()
+        path = self._canned(tmp_path)
+        with open(path, "a") as f:
+            f.write('{"ts": 3.0, "step": 9, "scal')   # SIGKILL mid-line
+        assert fs.main([str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "FLEET_STATUS " in out
+
+
+# ---------------------------------------------------------------------------
+# the disabled-cost pin
+
+
+class TestPublisherPointerTest:
+    def test_off_by_default(self):
+        assert fleet.get() is None
+
+    def test_configure_and_shutdown(self):
+        pub = fleet.configure(peer_id=3, kind="actor", interval_s=1.0)
+        assert fleet.get() is pub
+        assert pub.peer_id == 3
+        fleet.configure(peer_id=3, interval_s=0.0)   # <= 0 disables
+        assert fleet.get() is None
+
+    def test_pool_captures_pointer_at_construction(self):
+        """With the fanout off, the pool's whole per-boundary cost is
+        `self._fleet is None` (the faults.get()/tracing discipline)."""
+        import dataclasses
+
+        import jax
+
+        from dotaclient_tpu.actor.vec_runtime import VecActorPool
+        from dotaclient_tpu.config import default_config
+        from dotaclient_tpu.models import init_params, make_policy
+
+        cfg = default_config()
+        cfg = dataclasses.replace(
+            cfg,
+            env=dataclasses.replace(cfg.env, n_envs=2, max_dota_time=30.0),
+            ppo=dataclasses.replace(cfg.ppo, rollout_len=4),
+        )
+        policy = make_policy(cfg.model, cfg.obs, cfg.actions)
+        params = init_params(policy, jax.random.PRNGKey(0))
+        out = []
+        pool = VecActorPool(cfg, policy, params, seed=0, rollout_sink=out.extend)
+        assert pool._fleet is None
+        # and with a publisher configured, a fresh pool captures it
+        fleet.configure(peer_id=0, interval_s=100.0)
+        pool2 = VecActorPool(cfg, policy, params, seed=0, rollout_sink=out.extend)
+        assert pool2._fleet is fleet.get()
+
+    def test_maybe_publish_cadence_and_transportless_degrade(self):
+        class FakeTransport:
+            def __init__(self):
+                self.frames = []
+
+            def publish_metrics_bytes(self, payload):
+                self.frames.append(payload)
+
+        reg = telemetry.Registry()
+        reg.counter("actor/env_steps").inc(5)
+        pub = fleet.FleetPublisher(0, "actor", interval_s=3600.0, registry=reg)
+        t = FakeTransport()
+        assert pub.maybe_publish(t) is True    # first call ships
+        assert pub.maybe_publish(t) is False   # inside the interval
+        assert len(t.frames) == 1
+        snap = fleet.decode_snapshot(t.frames[0])
+        assert snap["counters"]["actor/env_steps"] == 5.0
+        # a lane without a metrics channel (AMQP, in-proc): silent no-op
+        assert pub.maybe_publish(object(), force=True) is False

@@ -468,6 +468,7 @@ class TestThreadOwnership:
             "TransportServer",
             "ShmTransportServer",
             "TraceWriter",
+            "FleetAggregator",   # ISSUE 13: ingest/evaluate/read split
         ):
             assert cls in declared, f"{cls} missing from OWNERSHIP"
 
@@ -495,6 +496,37 @@ class TestThreadOwnership:
             "        self._f.write('x')\n"
         )
         assert ownership.scan_source_with_map("x.py", good, trace_map) == []
+
+    def test_race_shape_fleet_ingest_touches_rule_state(self):
+        """ISSUE 13 regression fixture: the fleet aggregator's ingest
+        runs on transport READER threads and may only park snapshots in
+        the locked inbox — an unlocked cross-thread touch of the
+        merge/alert state (`_peers`, `_engine`) from the ingest path is
+        the race shape the shipped map must flag (baseline stays empty)."""
+        fleet_map = ownership.OWNERSHIP["dotaclient_tpu/utils/fleet.py"]
+        bad = (
+            "class FleetAggregator:\n"
+            "    def ingest(self, payload):\n"
+            "        self._peers['x'] = payload\n"      # reader → agg state
+            "        self._engine.evaluate({})\n"       # reader → rule state
+        )
+        out = ownership.scan_source_with_map("x.py", bad, fleet_map)
+        assert len(out) == 2
+        assert all("agg thread" in d.message for d in out)
+        assert all("reader thread" in d.message for d in out)
+        # the shipped split is clean: park under the lock, merge on agg
+        good = (
+            "class FleetAggregator:\n"
+            "    def ingest(self, payload):\n"
+            "        with self._lock:\n"
+            "            self._inbox.append(payload)\n"
+            "    def tick(self):\n"
+            "        with self._lock:\n"
+            "            batch, self._inbox = self._inbox, []\n"
+            "        self._peers.clear()\n"
+            "        self._engine.evaluate({})\n"
+        )
+        assert ownership.scan_source_with_map("x.py", good, fleet_map) == []
 
 
 # ---------------------------------------------------------------------------
