@@ -653,6 +653,14 @@ class TrajectoryBuffer:
         before ``min_fill`` has been reached for the first time (warmup
         diversity guard).
 
+        This gather is the CONSUME BOUNDARY of the one-pass advantage
+        plane (ISSUE 14): the learner runs its jitted advantage pass over
+        the batch returned here — once per batch, not per optimizer step
+        — and stages the narrow advantages/returns ON the batch dict, not
+        in the ring (slots hold wire-shaped experience only, so requeue/
+        rollback hygiene never has to invalidate derived tensors: they
+        die with the batch dict — see train/learner.py).
+
         When ``current_version`` is given, staleness is re-enforced here:
         every unconsumed slot whose producer version has fallen more than
         ``max_staleness`` behind is dropped (slots are scanned, not just the

@@ -71,6 +71,10 @@ ADV_NORM_MODES = ("batch", "none")
 # Valid PPOConfig.advantage estimators.
 ADVANTAGE_MODES = ("gae", "vtrace")
 
+# Valid PPOConfig.advantage_dtype storage widths for the one-pass
+# advantage plane's staged advantages/returns (train/advantage.py).
+ADVANTAGE_STORE_DTYPES = ("bfloat16", "float32")
+
 
 @dataclasses.dataclass(frozen=True)
 class PPOConfig:
@@ -153,6 +157,30 @@ class PPOConfig:
     # staged path remains for --checkify and as the explicit opt-out.
     # False forces the staged loop.
     fused_epoch: bool = True
+    # One-pass advantage plane (train/advantage.py): compute the value
+    # forward + GAE scan ONCE per consumed batch — a jitted, mesh-sharded
+    # pass at the buffer gather boundary — and train all epochs_per_batch
+    # × minibatches optimizer steps on the precomputed advantages/returns
+    # instead of re-running the estimator inside every step (HEPPO-GAE's
+    # pipeline-stage observation, PAPERS.md). This is the standard PPO
+    # regime (advantages fixed for the batch, from the params the batch's
+    # first update trains from); the in-step recompute remains for
+    # advantage="vtrace" (its importance ratios need the CURRENT policy's
+    # logp, which changes every optimizer step), for fused mode (the
+    # rollout+update program is strictly on-policy with E×M per-chunk
+    # updates of its own), and at steps_per_batch == 1 (the in-step
+    # estimator already runs once per batch there — a separate pass would
+    # add a forward, not remove one). False forces the per-step recompute
+    # everywhere.
+    one_pass_advantage: bool = True
+    # Storage width for the staged advantages/returns between the pass and
+    # the epoch step (the narrow-ring discipline of ISSUE 7 extended to
+    # the advantage plane): "bfloat16" halves the staged bytes and the
+    # loss upcasts at consume; "float32" opts out (bit-exact staging).
+    # The estimator's INPUTS (rewards, behavior_logp, dones, values) keep
+    # their pinned-f32 precision either way — only the derived outputs
+    # narrow.
+    advantage_dtype: str = "bfloat16"
 
     @property
     def steps_per_batch(self) -> int:
@@ -338,6 +366,14 @@ class LearnerConfig:
     # sync checkpoint anyway (a wedged disk must not turn a drain into a
     # hang; the sync save then surfaces the real error loudly).
     snapshot_drain_timeout_s: float = 60.0
+    # Compute-stage pipeline overlap (ISSUE 14, the OPPO observation):
+    # with the one-pass advantage plane on, run batch N+1's advantage
+    # pass on the prefetch lane — dispatch-only work enqueued behind
+    # batch N's in-flight donated epoch step — instead of at consume
+    # time. advantage/overlap_fraction measures how much of the pass's
+    # host time actually hid behind a dispatch. False defers every pass
+    # to consume time (the serial one-pass baseline bench.py measures).
+    overlap_advantage: bool = True
 
 
 @dataclasses.dataclass(frozen=True)

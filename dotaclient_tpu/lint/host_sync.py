@@ -71,6 +71,12 @@ ALLOWED_FUNCS: Dict[str, Set[str]] = {
     # fetched scalars (the engine's one batched transfer) — its float()
     # casts are annotated at the line.
     "dotaclient_tpu/train/health.py": set(),
+    # One-pass advantage plane (ISSUE 14): the consume-time pass runs on
+    # the train thread between a gather and a donated epoch step — it
+    # must be dispatch-only end to end (a hidden device_get there would
+    # serialize every consumed batch behind device compute); no
+    # function-level pass.
+    "dotaclient_tpu/train/advantage.py": set(),
     # The snapshot engine IS the designated sync site (ISSUE 5): its one
     # batched fetch is annotated at the line, everything else must stay
     # host-only — no function-level pass.

@@ -65,7 +65,7 @@ _INSTRUMENTED_PROGRAMS = (
     # `compile/<program>/...` wildcard rows in ARCHITECTURE.md.
     "train_step", "epoch_step", "fused_step", "minibatch_gather",
     "snap_copy", "buffer_scatter", "buffer_scatter_dev", "buffer_gather",
-    "serve_dispatch",
+    "serve_dispatch", "advantage_pass",
 )
 
 DYNAMIC_KEY_EXPANSIONS: Dict[Tuple[str, str], Tuple[str, ...]] = {
@@ -105,9 +105,10 @@ _DOC_KEY_RE = re.compile(
 # `carry0/*`) — never treated as documented-telemetry claims. A NEW
 # namespace must be added here when its first key is minted.
 KEY_PREFIXES = (
-    "actor/", "alerts/", "buffer/", "checkpoint/", "compile/", "faults/",
-    "fleet/", "health/", "league/", "learner/", "mem/", "mesh/", "serve/",
-    "shm/", "snapshot/", "span/", "trace/", "transport/",
+    "actor/", "advantage/", "alerts/", "buffer/", "checkpoint/",
+    "compile/", "faults/", "fleet/", "health/", "league/", "learner/",
+    "mem/", "mesh/", "serve/", "shm/", "snapshot/", "span/", "trace/",
+    "transport/",
 )
 # single-line inline code only: multi-line matches would mispair across
 # ``` fence lines (odd backtick count flips pairing for the whole doc)

@@ -1,5 +1,10 @@
-"""PPO learner: GAE, loss, pjit train step, training loop."""
+"""PPO learner: advantage plane, loss, pjit train step, training loop."""
 
+from dotaclient_tpu.train.advantage import (
+    advantages_and_returns,
+    make_advantage_pass,
+    one_pass_enabled,
+)
 from dotaclient_tpu.train.gae import gae, gae_reference
 from dotaclient_tpu.train.ppo import (
     Batch,
@@ -15,12 +20,15 @@ from dotaclient_tpu.train.ppo import (
 __all__ = [
     "Batch",
     "TrainState",
+    "advantages_and_returns",
     "example_batch",
     "gae",
     "gae_reference",
     "init_train_state",
+    "make_advantage_pass",
     "make_epoch_step",
     "make_optimizer",
     "make_train_step",
+    "one_pass_enabled",
     "ppo_loss",
 ]

@@ -1,0 +1,128 @@
+"""Consume-time advantage plane: one value forward + GAE per consumed batch.
+
+With ``epochs_per_batch × minibatches > 1`` the historical train step
+re-ran the value forward and the GAE scan inside every optimizer step —
+E×M redundant passes over work that is FIXED for the batch (the estimator
+consumes stop-gradient values, so nothing it produces depends on the
+update being taken). HEPPO-GAE (PAPERS.md) makes advantage estimation its
+own pipeline stage; this module is that stage: :func:`make_advantage_pass`
+compiles a jitted, mesh-sharded pass that runs the sequence forward + GAE
+ONCE over a just-gathered batch and hands back ``(advantages, returns)``
+at the narrow staging dtype (``ppo.advantage_dtype``, bf16 by default —
+the quantized-plane discipline of ISSUE 7 extended to the advantage
+leaves; the estimator's f32-pinned inputs are untouched, only the derived
+outputs narrow).
+
+The learner attaches the pair to the batch dict at the buffer gather
+boundary (``train/learner.py`` ``_next_batch``/``_prefetch_next``);
+``train/ppo.ppo_loss`` sees the ``advantages`` leaf, skips its in-step
+estimator, and shortens the loss forward to the T transition steps (the
+bootstrap slot existed solely to seed the estimator). With
+``learner.overlap_advantage`` (the default) the pass for batch N+1 is
+dispatch-only work enqueued behind batch N's in-flight donated epoch step
+— OPPO's phase overlap (PAPERS.md), extending the prefetch lane from
+"stage bytes" to "stage compute".
+
+Scope: GAE only. V-trace's importance ratios need the CURRENT policy's
+logp, which changes every optimizer step — precomputing would freeze the
+off-policy correction it exists to provide — so ``advantage="vtrace"``
+keeps the in-step recompute, as does fused mode (its rollout+update
+program is strictly on-policy and already amortizes per chunk).
+
+Discipline: the pass is dispatch-only (no host↔device sync — guarded by
+``lint/host_sync.py``, which scans this module) and donates nothing (the
+params are the live train state's and the batch is consumed by the very
+next epoch step).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from dotaclient_tpu.config import ADVANTAGE_STORE_DTYPES, RunConfig
+from dotaclient_tpu.models.policy import Policy
+from dotaclient_tpu.train.gae import gae
+
+
+def one_pass_enabled(config: RunConfig) -> bool:
+    """True iff the consume-time advantage plane applies to this config:
+    ``ppo.one_pass_advantage`` is on, the estimator is GAE (see module
+    docstring for why vtrace keeps the in-step recompute), AND the batch
+    is consumed more than once (``steps_per_batch > 1``). At E×M = 1 the
+    in-step estimator already runs exactly once per batch — a separate
+    pass would ADD a redundant value forward instead of removing E×M−1
+    of them, measurably slowing the default config."""
+    return (
+        config.ppo.one_pass_advantage
+        and config.ppo.advantage == "gae"
+        and config.ppo.steps_per_batch > 1
+    )
+
+
+def store_dtype(config: RunConfig):
+    """Staging dtype for the precomputed advantages/returns."""
+    name = config.ppo.advantage_dtype
+    if name not in ADVANTAGE_STORE_DTYPES:
+        raise ValueError(
+            f"unknown advantage_dtype {name!r} "
+            f"(one of {ADVANTAGE_STORE_DTYPES})"
+        )
+    return jnp.bfloat16 if name == "bfloat16" else jnp.float32
+
+
+def advantages_and_returns(
+    policy: Policy,
+    params: Any,
+    batch: Any,
+    cfg: Any,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The in-step recompute's exact ops, as a standalone stage: sequence
+    forward over the full ``[B, T+1]`` chunk (the trailing slot is the
+    bootstrap value), then the GAE reverse scan. The logits heads the
+    shared ``sequence`` apply also produces are unused here and XLA
+    dead-code-eliminates them — the pass compiles to the value trunk +
+    scan. Bitwise agreement with the recompute branch of
+    ``train/ppo.ppo_loss`` is pinned by tests/test_advantage.py."""
+    (_, values, _), _ = policy.apply(
+        params, batch["obs"], batch["carry0"], batch["dones"],
+        method="sequence", mutable=["losses"],
+    )
+    return gae(
+        batch["rewards"],
+        jax.lax.stop_gradient(values),
+        batch["dones"],
+        cfg.gamma,
+        cfg.gae_lambda,
+    )
+
+
+def make_advantage_pass(policy: Policy, config: RunConfig, mesh: Mesh):
+    """Compile the advantage pass against ``mesh``: ``(params, batch) →
+    (advantages, returns)`` at the staging dtype, batch-sharded over the
+    data axis like every other ``[B, ...]`` tensor in the pipeline.
+
+    No donation: the params are the live train state's (the next epoch
+    step donates them) and the batch is consumed by that same step. No
+    in_shardings pin: both inputs arrive committed (the state to its
+    state_shardings, the batch from the buffer's sharded gather)."""
+    if config.ppo.advantage != "gae":
+        raise ValueError(
+            "the one-pass advantage plane precomputes GAE only — "
+            "advantage='vtrace' needs the current policy's logp per "
+            "optimizer step and keeps the in-step recompute"
+        )
+    from dotaclient_tpu.parallel.mesh import data_sharding
+
+    ds = data_sharding(mesh, config.mesh)
+    dt = store_dtype(config)
+    cfg = config.ppo
+
+    def _pass(params, batch):
+        adv, ret = advantages_and_returns(policy, params, batch, cfg)
+        return adv.astype(dt), ret.astype(dt)
+
+    return jax.jit(_pass, out_shardings=(ds, ds))

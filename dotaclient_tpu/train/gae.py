@@ -2,14 +2,23 @@
 
 The reference computed GAE as a sequential Python/torch loop in its learner
 (SURVEY.md §3.2, BASELINE.json:5; reconstructed — the reference checkout was
-an empty mount). Here GAE runs ON DEVICE, INSIDE the jitted train step: the
-loss function calls :func:`gae` directly (``train/ppo.py:153``), so the
-reverse scan over time — batched over rollouts — compiles into the same XLA
-program as the forward pass, loss, and gradient, and XLA fuses it with the
-surrounding computation. There is no host-side GAE pass anywhere in the
-pipeline; values come from the current policy's forward in that same
-program (HEPPO-GAE, PAPERS.md, covers the hardware-friendly formulation
-space — a scan is already bandwidth-bound optimal at these sizes).
+an empty mount). Here GAE always runs ON DEVICE, in one of two jitted
+homes, and there is no host-side GAE pass anywhere in the pipeline:
+
+* **Consume-time advantage pass** (the default buffered-learner path,
+  ``train/advantage.py``): the value forward + the reverse scan run ONCE
+  per consumed batch at the buffer gather boundary, and every
+  ``epochs_per_batch × minibatches`` optimizer step trains on the staged
+  result — HEPPO-GAE's (PAPERS.md) advantage-estimation-as-pipeline-stage
+  idea, with the pass overlapped behind the in-flight epoch step.
+* **In-step recompute** (fused mode, vtrace, ``one_pass_advantage=false``):
+  the loss function calls :func:`gae`/:func:`vtrace` directly inside the
+  jitted train step, so the scan compiles into the same XLA program as
+  the forward, loss, and gradient — the historical shape, still the
+  right one wherever the estimator's inputs change per step.
+
+Either way values come from the policy's sequence forward in the same
+program (a scan is already bandwidth-bound optimal at these sizes).
 """
 
 from __future__ import annotations

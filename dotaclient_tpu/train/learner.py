@@ -390,6 +390,38 @@ class Learner:
                 ),
                 "epoch_step",
             )
+        # One-pass advantage plane (ISSUE 14, train/advantage.py): a
+        # jitted, mesh-sharded value-forward + GAE pass runs ONCE per
+        # consumed batch at the buffer gather boundary, and the epoch
+        # step consumes the staged (bf16-narrow) advantages/returns
+        # across all E×M updates instead of recomputing them per step.
+        # Fused mode trains in-program on-policy and vtrace needs the
+        # current policy's per-step logp — both keep the in-step
+        # recompute (one_pass_enabled gates on the estimator).
+        from dotaclient_tpu.train.advantage import (
+            make_advantage_pass,
+            one_pass_enabled,
+        )
+
+        self.advantage_pass = None
+        self._adv_overlap = config.learner.overlap_advantage
+        self._adv_overlapped_s = 0.0
+        self._adv_serial_s = 0.0
+        self._adv_first = True   # first pass pays compile: not accounted
+        if mode != "fused" and one_pass_enabled(config):
+            self.advantage_pass = tracing.instrument_jit(
+                make_advantage_pass(self.policy, config, self.mesh),
+                "advantage_pass",
+            )
+        # eager-created so ANY learner JSONL validates
+        # `check_telemetry_schema.py --require-advantage` (a recompute run
+        # reports one_pass=0 and zeros, never missing keys)
+        reg.gauge("advantage/one_pass").set(
+            1.0 if self.advantage_pass is not None else 0.0
+        )
+        reg.gauge("advantage/pass_ms")
+        reg.gauge("advantage/overlap_fraction")
+        reg.counter("advantage/passes_total")
         # Fused mode trains each chunk inside its one program and never
         # stages experience: allocating the HBM ring there would pin
         # capacity_rollouts chunks of dead device memory.
@@ -687,6 +719,13 @@ class Learner:
             # rollback must restore last_good.
             batch = dict(batch)
             batch["rewards"] = batch["rewards"].at[0, 0].set(jnp.nan)
+            if "advantages" in batch:
+                # one-pass batches: the poisoned reward would have flowed
+                # through the consume-time pass — mirror it into the
+                # staged advantages or the loss never sees the NaN
+                batch["advantages"] = (
+                    batch["advantages"].at[0, 0].set(jnp.nan)
+                )
         cfg = self.config.ppo
         M = max(1, cfg.minibatches)
         E = cfg.epochs_per_batch
@@ -763,7 +802,9 @@ class Learner:
             self.buffer.release(self._prefetch_ticket)
             self._prefetch_ticket = None
             self._prefetch_hits += 1
-            return batch
+            # overlap_advantage=false stages the batch bare — the pass
+            # runs here, at consume time (no-op when already attached)
+            return self._attach_advantages(batch)
         t0 = time.perf_counter()
         if drain_transport:
             self.ingest()
@@ -774,6 +815,7 @@ class Learner:
             # cost (same rule the transport/consume span applies)
             self._prefetch_serial_s += time.perf_counter() - t0
             self._prefetch_misses += 1
+            batch = self._attach_advantages(batch)
         return batch
 
     def _prefetch_next(self, drain_transport: bool = True) -> None:
@@ -805,15 +847,67 @@ class Learner:
             self._prefetch_overlapped_s += dt
         else:
             self._prefetch_serial_s += dt
+        if self._adv_overlap:
+            # stage compute, not just bytes (ISSUE 14): batch N+1's
+            # advantage pass dispatches behind batch N's in-flight epoch
+            # step — device-stream ordering runs it on the step's OUTPUT
+            # params, exactly the params the staged batch's first update
+            # will train from
+            self._prefetched = self._attach_advantages(
+                self._prefetched, overlapped=self._dispatch_inflight
+            )
 
     def _flush_prefetch(self) -> None:
         """Return an unconsumed prefetched batch to the ring (front of the
         order) before anything that snapshots or ends the run — prefetching
-        must never turn into experience loss."""
+        must never turn into experience loss. Advantages staged on the
+        batch (``_attach_advantages``) die with it: only the ring slots
+        survive, so the next take re-runs the pass with whatever params
+        are live then — the invariant the divergence rollback leans on."""
         if self._prefetched is not None:
             self.buffer.requeue(self._prefetch_ticket)
             self._prefetched = None
             self._prefetch_ticket = None
+
+    def _attach_advantages(self, batch, overlapped: bool = False):
+        """Consume-time advantage plane (ISSUE 14, train/advantage.py):
+        run the jitted value-forward + GAE pass over a just-gathered
+        batch and attach the narrow ``advantages``/``returns`` leaves the
+        epoch step consumes across all E×M updates. Dispatch-only: the
+        host enqueues one program (behind the in-flight donated epoch
+        step when called from the prefetch lane) and appends two array
+        futures to the batch dict — no sync anywhere.
+
+        ``overlapped`` is the CALLER's classification: only the prefetch
+        lane stages the pass behind an in-flight dispatch; consume-time
+        passes count serial. (``_dispatch_inflight`` alone cannot
+        classify — the dispatch-only loop never clears it between
+        batches in async-snapshot mode, so it would peg the fraction at
+        1.0 even with ``overlap_advantage=false``.)"""
+        if (
+            self.advantage_pass is None
+            or batch is None
+            or "advantages" in batch
+        ):
+            return batch
+        t0 = time.perf_counter()
+        adv, ret = self.advantage_pass(self.state.params, batch)
+        batch = dict(batch)
+        batch["advantages"] = adv
+        batch["returns"] = ret
+        dt = time.perf_counter() - t0
+        self.telemetry.gauge("advantage/pass_ms").set(dt * 1e3)
+        self.telemetry.counter("advantage/passes_total").inc()
+        if self._adv_first:
+            # the first call pays the pass's XLA compile — steady-state
+            # dispatch is sub-ms, so folding seconds of compile into the
+            # serial bucket would flatten overlap_fraction to noise
+            self._adv_first = False
+        elif overlapped:
+            self._adv_overlapped_s += dt
+        else:
+            self._adv_serial_s += dt
+        return batch
 
     def _actor_params_copy(self):
         """Device-to-device copy of the current params for the actor pool:
@@ -1010,7 +1104,11 @@ class Learner:
         self.ckpt.discard_steps_above(self._host_step)
         # experience produced by the poisoned policy is dropped (slots
         # tagged with a version inside the poisoned range); the prefetch
-        # lane is flushed first so held slots fold back in
+        # lane is flushed first so held slots fold back in — and with it
+        # die any STAGED ADVANTAGES computed by the poisoned params (they
+        # ride the flushed batch dict, never the ring): the retrained
+        # timeline's takes re-run the pass with the restored params,
+        # pinned by tests/test_advantage.py
         if self.buffer is not None:
             self._flush_prefetch()
             self.buffer.drop_newer_than(restored_version)
@@ -1336,6 +1434,15 @@ class Learner:
         if staged > 0:
             self.telemetry.gauge("learner/overlap_fraction").set(
                 self._prefetch_overlapped_s / staged
+            )
+        # advantage-plane overlap (ISSUE 14): pass host time staged from
+        # the prefetch lane behind an in-flight dispatch / all pass host
+        # time (consume-time passes count serial) — the proof the
+        # compute stage pipelines, reported next to the byte-staging one
+        adv_staged = self._adv_overlapped_s + self._adv_serial_s
+        if adv_staged > 0:
+            self.telemetry.gauge("advantage/overlap_fraction").set(
+                self._adv_overlapped_s / adv_staged
             )
         # device-memory watermark (ISSUE 12): host-only allocator metadata,
         # refreshed at log cadence; CPU backends report none → stays 0

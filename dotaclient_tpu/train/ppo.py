@@ -7,11 +7,19 @@ clipped-surrogate PPO loss with entropy bonus and value loss, grad-clip, Adam
 an empty mount).
 
 TPU-first shape (SURVEY.md §7 step 4): the whole loop body — sequence
-forward, GAE, loss, gradient, ``psum`` over the data axis, Adam update — is
+forward, loss, gradient, ``psum`` over the data axis, Adam update — is
 ONE jitted function with donated train-state buffers, compiled once against a
 ``(data, model)`` mesh. The gradient all-reduce is emitted by XLA from the
 sharding annotations (batch sharded over ``data``, params replicated); there
 is no hand-written collective.
+
+Advantage estimation is its own pipeline stage (the one-pass advantage
+plane, ``train/advantage.py``): a batch arriving with precomputed
+``advantages``/``returns`` leaves trains all ``epochs_per_batch ×
+minibatches`` updates on them over a T-step forward. Batches without the
+leaves (fused mode, vtrace, ``one_pass_advantage=false``, and every direct
+caller of :func:`make_train_step`) keep the in-step estimator over the
+full T+1 chunk — the historical behavior, bitwise.
 """
 
 from __future__ import annotations
@@ -132,11 +140,20 @@ def ppo_loss(
     ``anchor_params`` (with ``cfg.anchor_kl_coef > 0``) adds the anchor-KL
     regularizer: one extra frozen-policy forward over the batch, exact
     conditional KL(π_θ ‖ π_anchor) per frame (PPOConfig.anchor_kl_coef).
+
+    A batch carrying precomputed ``advantages``/``returns`` leaves (the
+    one-pass advantage plane, ``train/advantage.py``) skips the in-step
+    estimator entirely and shortens the forward to the T transition steps
+    — the bootstrap slot existed solely to seed the estimator, so every
+    forward AND backward in the epoch drops one timestep.
     """
     obs = batch["obs"]
     T = batch["rewards"].shape[1]
     valid = batch["valid"].astype(jnp.float32)
     n_valid = jnp.maximum(valid.sum(), 1.0)
+    precomputed = "advantages" in batch
+    if precomputed:
+        obs = {k: v[:, :T] for k, v in obs.items()}
 
     (logits, values, _), mutated = policy.apply(
         params, obs, batch["carry0"], batch["dones"], method="sequence",
@@ -150,7 +167,14 @@ def ppo_loss(
 
     logp = D.log_prob(logits_t, obs_t, batch["actions"])
 
-    if cfg.advantage == "gae":
+    if precomputed:
+        # Consume-time advantages (train/advantage.py): upcast from the
+        # bf16 staging dtype; both are constants to the optimizer (the
+        # pass ran on stop-gradient values), exactly like the in-step
+        # estimator's outputs below.
+        adv = batch["advantages"].astype(jnp.float32)
+        returns = batch["returns"].astype(jnp.float32)
+    elif cfg.advantage == "gae":
         adv, returns = gae(
             batch["rewards"],
             jax.lax.stop_gradient(values),
@@ -328,6 +352,11 @@ def _train_step(
             params_new, lp_pre = operand
             T = batch["rewards"].shape[1]
             obs = batch["obs"]
+            if "advantages" in batch:
+                # one-pass batches train on a T-step forward (the
+                # bootstrap slot only fed the estimator) — measure the
+                # post-update KL over the same window
+                obs = {k: v[:, :T] for k, v in obs.items()}
             (logits_post, _, _), _ = policy.apply(
                 params_new, obs, batch["carry0"], batch["dones"],
                 method="sequence", mutable=["losses"],
@@ -462,9 +491,10 @@ def make_train_step(
     # slice, one slice-level all-reduce over DCN
     data_sharding = _data_sharding(mesh, config.mesh)
     repl = NamedSharding(mesh, P())
-    batch_shardings = jax.tree.map(
-        lambda _: data_sharding, example_batch(config, batch=1, as_struct=True)
-    )
+    # a bare sharding broadcasts over the whole batch pytree, so the
+    # compiled contract is structure-agnostic: a batch may carry the
+    # optional precomputed-advantage leaves (train/advantage.py) or not
+    batch_shardings = data_sharding
     state_sharding = train_state_sharding(policy, config, mesh)
     metrics_repl = repl
     if debug_checkify:
@@ -552,9 +582,10 @@ def make_epoch_step(
     mb = B // M
     ds = _data_sharding(mesh, config.mesh)
     repl = NamedSharding(mesh, P())
-    batch_shardings = jax.tree.map(
-        lambda _: ds, example_batch(config, batch=1, as_struct=True)
-    )
+    # bare sharding = structure-agnostic contract (see make_train_step):
+    # one-pass batches add advantages/returns leaves, sliced per
+    # minibatch by the same in-program jnp.take as every other leaf
+    batch_shardings = ds
     state_sharding = train_state_sharding(policy, config, mesh)
 
     def epoch_step(state, batch, perms):

@@ -202,6 +202,12 @@ ALERT_WAIVERS: Dict[str, str] = {
         "diagnostic gauge pair with no universal threshold; compared "
         "against bench stages by a human"
     ),
+    "rb:advantage-speedup": (
+        "bench-time capability gate; the runtime overlap fraction varies "
+        "legitimately with consume patterns (serial consume-time passes "
+        "are correct, just unoverlapped) — compared against bench stages "
+        "by a human"
+    ),
     "rb:divergence-exhausted": (
         "terminal non-zero exit is its own page; the precursor pages via "
         "rb:divergence"

@@ -216,6 +216,18 @@ TRACE_KEYS = (
     "mem/hbm_peak_bytes",           # device allocator peak (max over devices)
 )
 
+# One-pass advantage plane (ISSUE 14). Validated with --require-advantage
+# against ANY learner run's JSONL: the Learner eager-creates every one of
+# these at construction — a recompute-mode run (one_pass_advantage=false,
+# vtrace, fused mode) deterministically reports advantage/one_pass = 0
+# and zeros, never missing keys.
+ADVANTAGE_KEYS = (
+    "advantage/one_pass",          # 1 when the consume-time pass is live
+    "advantage/pass_ms",           # last pass's host dispatch time
+    "advantage/overlap_fraction",  # pass host time hidden behind a dispatch
+    "advantage/passes_total",      # consume-time passes run
+)
+
 # Fleet health plane (ISSUE 13). Validated with --require-fleet against
 # ANY learner run's JSONL: the Learner constructs its FleetAggregator
 # unconditionally, which eager-creates every rollup/alert key at
@@ -399,6 +411,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         "eager-creates every rollup and alert key at construction",
     )
     p.add_argument(
+        "--require-advantage", action="store_true",
+        help="also require the one-pass advantage-plane keys (ISSUE 14); "
+        "valid against ANY learner run's JSONL — the Learner eager-creates "
+        "them whether the pass is live or the run recomputes in-step",
+    )
+    p.add_argument(
         "--require-multichip", action="store_true",
         help="also require the multi-chip learner keys (ISSUE 10); valid "
         "against ANY learner run's JSONL at any device count — the "
@@ -421,6 +439,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         extra += HEALTH_KEYS
     if args.require_serve:
         extra += SERVE_KEYS
+    if args.require_advantage:
+        extra += ADVANTAGE_KEYS
     if args.require_multichip:
         extra += MULTICHIP_KEYS
     if args.require_trace:

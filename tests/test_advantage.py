@@ -1,0 +1,428 @@
+"""One-pass advantage plane tests (ISSUE 14, train/advantage.py).
+
+Pins: the pass is bitwise-equal to the in-step recompute at f32 (and
+within bf16 tolerance when stored narrow), the one-pass train step
+matches the recompute step to float-ulp XLA-fusion rounding, the staged
+and fused epoch paths agree on one-pass batches at E×M = 4, the learner
+wires/gates/reports the plane, a divergence rollback discards staged
+advantages with the flushed prefetch lane, and the telemetry tier +
+lint coverage hold.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dotaclient_tpu.config import RunConfig
+from dotaclient_tpu.models import init_params, make_policy
+from dotaclient_tpu.parallel import make_mesh
+from dotaclient_tpu.train import (
+    example_batch,
+    init_train_state,
+    make_epoch_step,
+    make_train_step,
+)
+from dotaclient_tpu.train.advantage import (
+    advantages_and_returns,
+    make_advantage_pass,
+    one_pass_enabled,
+    store_dtype,
+)
+from dotaclient_tpu.utils import telemetry
+
+
+def small_cfg(**ppo) -> RunConfig:
+    cfg = RunConfig()
+    return dataclasses.replace(
+        cfg,
+        env=dataclasses.replace(cfg.env, n_envs=4, max_dota_time=30.0),
+        ppo=dataclasses.replace(
+            cfg.ppo, **{"rollout_len": 8, "batch_rollouts": 8, **ppo}
+        ),
+        buffer=dataclasses.replace(
+            cfg.buffer, capacity_rollouts=32, min_fill=8
+        ),
+        log_every=1000,
+        checkpoint_every=1000,
+    )
+
+
+def random_batch(cfg: RunConfig, batch: int, seed: int):
+    rng = np.random.default_rng(seed)
+    B, T = batch, cfg.ppo.rollout_len
+    out = example_batch(cfg, batch=B)
+    out["obs"] = dict(out["obs"])
+    out["obs"]["units"] = jnp.asarray(
+        rng.normal(size=out["obs"]["units"].shape).astype(np.float32)
+    )
+    out["rewards"] = jnp.asarray(
+        rng.normal(size=(B, T)).astype(np.float32) * 0.1
+    )
+    out["behavior_logp"] = jnp.asarray(
+        -np.abs(rng.normal(size=(B, T))).astype(np.float32)
+    )
+    out["dones"] = jnp.asarray(
+        (rng.random((B, T)) < 0.1).astype(np.float32)
+    )
+    return out
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = small_cfg()
+    policy = make_policy(cfg.model, cfg.obs, cfg.actions)
+    params = init_params(policy, jax.random.PRNGKey(0))
+    return cfg, policy, params
+
+
+class TestPassParity:
+    def test_gating(self):
+        # E×M = 1: the in-step estimator already runs once per batch —
+        # the plane only engages when it can amortize
+        assert not one_pass_enabled(small_cfg())
+        assert one_pass_enabled(small_cfg(epochs_per_batch=2))
+        assert one_pass_enabled(small_cfg(minibatches=2, batch_rollouts=16))
+        assert not one_pass_enabled(
+            small_cfg(epochs_per_batch=2, one_pass_advantage=False)
+        )
+        assert not one_pass_enabled(
+            small_cfg(epochs_per_batch=2, advantage="vtrace")
+        )
+        assert store_dtype(small_cfg()) == jnp.bfloat16
+        assert (
+            store_dtype(small_cfg(advantage_dtype="float32")) == jnp.float32
+        )
+        with pytest.raises(ValueError, match="advantage_dtype"):
+            store_dtype(small_cfg(advantage_dtype="fp8"))
+
+    def test_vtrace_pass_rejected(self, setup):
+        cfg, policy, _ = setup
+        vcfg = dataclasses.replace(
+            cfg, ppo=dataclasses.replace(cfg.ppo, advantage="vtrace")
+        )
+        with pytest.raises(ValueError, match="vtrace"):
+            make_advantage_pass(policy, vcfg, make_mesh(cfg.mesh))
+
+    def test_pass_bitwise_equals_in_step_recompute_at_f32(self, setup):
+        """The pinned contract: the pass's f32 output IS the in-step
+        estimator — same apply, same scan, compiled standalone."""
+        cfg, policy, params = setup
+        mesh = make_mesh(cfg.mesh)
+        batch = random_batch(cfg, batch=8, seed=1)
+        f32 = dataclasses.replace(
+            cfg, ppo=dataclasses.replace(cfg.ppo, advantage_dtype="float32")
+        )
+        adv, ret = make_advantage_pass(policy, f32, mesh)(params, batch)
+        ref = jax.jit(
+            lambda p, b: advantages_and_returns(policy, p, b, cfg.ppo)
+        )
+        adv_ref, ret_ref = ref(params, batch)
+        assert adv.dtype == jnp.float32
+        assert np.array_equal(np.asarray(adv), np.asarray(adv_ref))
+        assert np.array_equal(np.asarray(ret), np.asarray(ret_ref))
+
+    @pytest.mark.slow   # tier-1 duration audit: two train-step traces, ~6s
+    def test_one_pass_step_matches_recompute_step(self, setup):
+        """A train step consuming the f32 pass output must match the
+        in-step-recompute step on the same params/batch — to the
+        float-ulp rounding of the T-vs-T+1 forward fusion (the only
+        difference between the two compiled programs)."""
+        cfg, policy, params = setup
+        mesh = make_mesh(cfg.mesh)
+        batch = random_batch(cfg, batch=8, seed=2)
+        f32 = dataclasses.replace(
+            cfg, ppo=dataclasses.replace(cfg.ppo, advantage_dtype="float32")
+        )
+        adv, ret = make_advantage_pass(policy, f32, mesh)(params, batch)
+        step = make_train_step(policy, cfg, mesh)
+        s_re, m_re = step(init_train_state(params, cfg.ppo), batch)
+        s_op, m_op = step(
+            init_train_state(params, cfg.ppo),
+            {**batch, "advantages": adv, "returns": ret},
+        )
+        for k in ("loss", "policy_loss", "value_loss", "entropy"):
+            np.testing.assert_allclose(
+                np.asarray(m_re[k]), np.asarray(m_op[k]),
+                rtol=1e-5, atol=1e-7,
+            )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+            ),
+            s_re.params,
+            s_op.params,
+        )
+
+    def test_bf16_storage_within_tolerance(self, setup):
+        cfg, policy, params = setup
+        mesh = make_mesh(cfg.mesh)
+        batch = random_batch(cfg, batch=8, seed=3)
+        f32 = dataclasses.replace(
+            cfg, ppo=dataclasses.replace(cfg.ppo, advantage_dtype="float32")
+        )
+        adv32, ret32 = make_advantage_pass(policy, f32, mesh)(params, batch)
+        adv16, ret16 = make_advantage_pass(policy, cfg, mesh)(params, batch)
+        assert adv16.dtype == jnp.bfloat16 and ret16.dtype == jnp.bfloat16
+        # bf16 has 8 mantissa bits: relative error ≤ 2^-8 per element
+        np.testing.assert_allclose(
+            np.asarray(adv16, np.float32), np.asarray(adv32),
+            rtol=2 ** -7, atol=1e-3,
+        )
+        np.testing.assert_allclose(
+            np.asarray(ret16, np.float32), np.asarray(ret32),
+            rtol=2 ** -7, atol=1e-3,
+        )
+
+
+class TestEpochParity:
+    @pytest.mark.slow   # tier-1 duration audit: epoch-step + staged traces, ~6s
+    def test_staged_equals_fused_on_one_pass_batches_at_exm4(self, setup):
+        """End-to-end epoch parity at E×M = 4 on PRECOMPUTED advantages:
+        the staged gather+step loop and the fused epoch scan consume the
+        same staged leaves and must produce the same updates (the
+        float-ulp XLA-fusion bound of tests/test_train.py's recompute
+        parity test)."""
+        cfg, policy, params = setup
+        # tests run at 8 forced host devices (conftest): minibatch size
+        # B/M must divide the batch shard count, so B=16 with M=2
+        E, M, B = 2, 2, 16
+        ecfg = dataclasses.replace(
+            cfg,
+            ppo=dataclasses.replace(
+                cfg.ppo, epochs_per_batch=E, minibatches=M, batch_rollouts=B
+            ),
+        )
+        mesh = make_mesh(ecfg.mesh)
+        batch = random_batch(ecfg, batch=B, seed=4)
+        adv, ret = make_advantage_pass(policy, ecfg, mesh)(params, batch)
+        aug = {**batch, "advantages": adv, "returns": ret}
+        perms = np.stack(
+            [np.random.default_rng(41).permutation(B) for _ in range(E)]
+        ).astype(np.int32)
+
+        from dotaclient_tpu.parallel import data_sharding
+
+        gather = jax.jit(
+            lambda b, idx: jax.tree.map(lambda x: x[idx], b),
+            out_shardings=data_sharding(mesh, ecfg.mesh),
+        )
+        step = make_train_step(policy, ecfg, mesh)
+        staged = init_train_state(params, ecfg.ppo)
+        mb = B // M
+        for e in range(E):
+            for i in range(M):
+                idx = jnp.asarray(perms[e, i * mb:(i + 1) * mb], jnp.int32)
+                staged, _ = step(staged, gather(aug, idx))
+
+        epoch_step = make_epoch_step(policy, ecfg, mesh)
+        fused = init_train_state(params, ecfg.ppo)
+        fused, _ = epoch_step(fused, aug, jnp.asarray(perms))
+        assert int(fused.step) == int(staged.step) == E * M
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-7
+            ),
+            fused.params,
+            staged.params,
+        )
+
+
+class TestLearnerIntegration:
+    @pytest.mark.slow   # tier-1 duration audit: full learner construction, ~14s
+    def test_learner_runs_one_pass_at_exm4_and_reports(self):
+        """Device-mode learner at E×M = 4: the plane is live, batches
+        train through the fused epoch step on precomputed advantages,
+        and every advantage/ key reports."""
+        from dotaclient_tpu.train.learner import Learner
+
+        cfg = small_cfg(
+            epochs_per_batch=2, minibatches=2, batch_rollouts=16
+        )
+        cfg = dataclasses.replace(
+            cfg,
+            env=dataclasses.replace(cfg.env, n_envs=8),
+            buffer=dataclasses.replace(
+                cfg.buffer, capacity_rollouts=32, min_fill=16
+            ),
+        )
+        learner = Learner(cfg, actor="device")
+        try:
+            assert learner.advantage_pass is not None
+            stats = learner.train(4)   # one consumed batch = 4 steps
+            assert stats["optimizer_steps"] == 4
+            assert int(learner.state.step) == 4
+            snap = telemetry.get_registry().snapshot()
+            assert snap["advantage/one_pass"] == 1.0
+            assert snap["advantage/passes_total"] >= 1.0
+            assert snap["advantage/pass_ms"] >= 0.0
+            assert 0.0 <= snap.get("advantage/overlap_fraction", 0.0) <= 1.0
+        finally:
+            if learner._snap_engine is not None:
+                learner._snap_engine.stop()
+
+    def test_opt_out_and_vtrace_keep_recompute(self):
+        from dotaclient_tpu.train.learner import Learner
+
+        # epochs_per_batch=2 so the KNOB (not the E×M = 1 gate) is what
+        # disables the plane in each case
+        for ppo in (
+            {"one_pass_advantage": False, "epochs_per_batch": 2},
+            {"advantage": "vtrace", "epochs_per_batch": 2},
+        ):
+            learner = Learner(small_cfg(**ppo), actor="device")
+            try:
+                assert learner.advantage_pass is None
+                assert (
+                    telemetry.get_registry().snapshot()["advantage/one_pass"]
+                    == 0.0
+                )
+            finally:
+                if learner._snap_engine is not None:
+                    learner._snap_engine.stop()
+
+    def test_fused_mode_has_no_pass(self):
+        from dotaclient_tpu.train.learner import Learner
+
+        cfg = small_cfg(epochs_per_batch=2)
+        cfg = dataclasses.replace(
+            cfg, env=dataclasses.replace(cfg.env, n_envs=8)
+        )
+        learner = Learner(cfg, actor="fused")
+        try:
+            assert learner.advantage_pass is None
+        finally:
+            if learner._snap_engine is not None:
+                learner._snap_engine.stop()
+
+
+class TestRollbackHygiene:
+    @pytest.mark.slow   # tier-1 duration audit: learner + checkpoint round trip, ~16s
+    def test_rollback_discards_staged_advantages(self, tmp_path):
+        """The pin: a divergence rollback flushes the prefetch lane, and
+        with it every advantage staged by the (possibly poisoned) params
+        — the requeued slots re-gather and re-pass under the restored
+        params on the next take."""
+        from dotaclient_tpu.train.learner import Learner
+
+        cfg = small_cfg(epochs_per_batch=2)   # E×M > 1: the plane is live
+        cfg = dataclasses.replace(
+            cfg, env=dataclasses.replace(cfg.env, n_envs=8)
+        )
+        learner = Learner(
+            cfg, actor="device", checkpoint_dir=str(tmp_path / "ck")
+        )
+        try:
+            # train(2)'s end-of-run forced save is verdict-clean → it
+            # earns the last_good mark the rollback restores
+            learner.train(2)
+            # refill the ring and stage a prefetched batch + advantages
+            chunk, _ = learner.device_actor.collect(learner.state.params)
+            learner.buffer.add_device(chunk, learner._host_version)
+            learner._prefetch_next(drain_transport=False)
+            assert learner._prefetched is not None
+            assert "advantages" in learner._prefetched
+            size_before = learner.buffer.size
+            # latch divergence (the sync fold path: NaN loss verdict)
+            learner._health.fold_host(
+                learner._host_step,
+                learner._host_version,
+                {"loss": float("nan"), "grad_norm": 1.0, "health_ok": 0.0},
+            )
+            assert learner._health.unhealthy is not None
+            rewound = learner._maybe_rollback()
+            assert rewound >= 0
+            # the staged batch (and its advantages) are GONE; its slots
+            # folded back into the ring for the retrained timeline
+            assert learner._prefetched is None
+            assert learner._prefetch_ticket is None
+            assert learner.buffer.size == size_before + cfg.ppo.batch_rollouts
+            assert learner._health.unhealthy is None
+            # the next take re-runs the pass under the restored params
+            batch = learner._next_batch(drain_transport=False)
+            assert batch is not None and "advantages" in batch
+            assert np.isfinite(
+                np.asarray(batch["advantages"], np.float32)
+            ).all()
+        finally:
+            if learner._snap_engine is not None:
+                learner._snap_engine.stop()
+            if learner.ckpt is not None:
+                learner.ckpt.wait()
+                learner.ckpt.close()
+
+
+class TestSchemaAndLint:
+    def test_advantage_tier_round_trip(self):
+        """--require-advantage: a line carrying the tier validates; a
+        line missing any advantage/ key fails with the tier named."""
+        import scripts.check_telemetry_schema as mod
+
+        keys = set(mod.ADVANTAGE_KEYS)
+        for k in mod.REQUIRED_KEYS:
+            if k.startswith("span/"):
+                root = k.rsplit("/", 1)[0]
+                keys.update(f"{root}/{leaf}" for leaf in mod.TIMER_LEAVES)
+            else:
+                keys.add(k)
+        import json
+
+        ok_line = json.dumps(
+            {"ts": 1.0, "step": 1, "scalars": {k: 0.0 for k in sorted(keys)}}
+        )
+        assert not mod.validate_lines(
+            [ok_line], extra_required=mod.ADVANTAGE_KEYS
+        )
+        bare = json.dumps(
+            {
+                "ts": 1.0,
+                "step": 1,
+                "scalars": {
+                    k: 0.0 for k in sorted(keys - set(mod.ADVANTAGE_KEYS))
+                },
+            }
+        )
+        errors = mod.validate_lines([bare], extra_required=mod.ADVANTAGE_KEYS)
+        assert errors and "advantage/one_pass" in errors[0]
+
+    def test_host_sync_scans_advantage_module(self):
+        """The pass must stay dispatch-only: the host-sync lint scans
+        train/advantage.py whole (no allowed functions) and finds it
+        clean today."""
+        import os
+
+        from dotaclient_tpu.lint.core import REPO_ROOT
+        from dotaclient_tpu.lint.host_sync import ALLOWED_FUNCS, check_source
+
+        rel = "dotaclient_tpu/train/advantage.py"
+        assert ALLOWED_FUNCS[rel] == set()
+        with open(os.path.join(REPO_ROOT, rel)) as f:
+            assert check_source(f.read(), set(), rel) == []
+
+    def test_donation_registry_would_track_a_donating_pass(self):
+        """make_advantage_pass deliberately donates nothing (params are
+        live, the batch is consumed next) — but if it ever grows a
+        donate_argnums, the use-after-donate factory registry must pick
+        it up package-wide, exactly like make_train_step."""
+        from dotaclient_tpu.lint.core import FileCtx
+        from dotaclient_tpu.lint.donation import build_factory_registry
+
+        donating = (
+            "import jax\n"
+            "def make_advantage_pass(policy, config, mesh):\n"
+            "    def _pass(params, batch):\n"
+            "        return batch\n"
+            "    return jax.jit(_pass, donate_argnums=(1,))\n"
+        )
+        ctx = FileCtx("x.py", donating)
+        registry = build_factory_registry({"x.py": ctx})
+        assert registry.get("make_advantage_pass") == (1,)
+        # and the real one is donation-free by design
+        import inspect
+
+        from dotaclient_tpu.train import advantage
+
+        src = inspect.getsource(advantage)
+        assert "donate_argnums" not in src
