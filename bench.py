@@ -507,6 +507,113 @@ def bench_fleet(config) -> dict:
     return out
 
 
+def bench_outcome(config) -> dict:
+    """Outcome stage (ISSUE 15): fused-path step throughput with the
+    outcome attribution plane's learner-side aggregation OFF vs ON.
+
+    The in-graph extraction (done-masked per-bucket reductions + the
+    episode-length histogram scatter-add inside the rollout program) is
+    part of the rollout math itself and rides BOTH variants — XLA fuses a
+    handful of masked sums into the existing stats reductions. What this
+    stage prices is everything the plane ADDS at the learner: a live
+    FleetAggregator merging 4 synthetic peers' outcome-bearing snapshot
+    frames (the real encode→ingest→delta-merge path) with the
+    OutcomeAggregator's windowed curve pass hooked into every tick, at a
+    50 ms cadence — 100× the production fleet interval, so the budget has
+    nowhere to hide. Acceptance: ``outcome_overhead`` ≤ 0.02 of fused
+    throughput (the PR 13 fleet-stage pattern; best-of-2 segments per
+    variant on this noise-prone host)."""
+    import dataclasses
+    import threading
+
+    from dotaclient_tpu.outcome import OutcomeAggregator
+    from dotaclient_tpu.outcome.records import REWARD_TERMS
+    from dotaclient_tpu.train.learner import Learner
+    from dotaclient_tpu.utils import telemetry
+    from dotaclient_tpu.utils.fleet import FleetAggregator, encode_snapshot
+
+    base = dataclasses.replace(
+        config,
+        env=dataclasses.replace(
+            config.env, n_envs=128, opponent="scripted_easy",
+            max_dota_time=120.0,
+        ),
+        log_every=10**9,   # no boundaries: the outcome plane is the subject
+    )
+    steps = 100
+    out: dict = {}
+    for label in ("off", "on"):
+        agg = None
+        feeder = None
+        learner = None
+        stop = threading.Event()
+        # everything that starts a thread sits INSIDE the try: a failed
+        # Learner construction must still tear the 50 ms feeder and the
+        # live aggregator down, or they keep mutating the global registry
+        # under every later bench stage (review finding)
+        try:
+            if label == "on":
+                agg = FleetAggregator(interval_s=0.05, emit_event=None)
+                outcome = OutcomeAggregator(window_s=5.0)
+                agg.add_tick_hook(outcome.tick)
+                agg.start()
+
+                def _feed() -> None:
+                    episodes = 0.0
+                    seq = 0
+                    while not stop.wait(0.05):
+                        episodes += 4.0
+                        seq += 1
+                        counters = {
+                            "outcome/episodes/vs_scripted": episodes,
+                            "outcome/wins/vs_scripted": episodes * 0.6,
+                            "outcome/ep_len_sum": episodes * 150.0,
+                            "outcome/ep_len_hist/07": episodes,
+                            **{
+                                f"outcome/reward_sum/{t}": episodes
+                                for t in REWARD_TERMS
+                            },
+                        }
+                        for peer in range(4):
+                            agg.ingest(
+                                encode_snapshot(
+                                    peer, "actor", seq, counters, {}
+                                )
+                            )
+
+                feeder = threading.Thread(
+                    target=_feed, name="outcome-bench-feeder", daemon=True
+                )
+                feeder.start()
+            learner = Learner(base, actor="fused")
+            learner.train(10)   # compile + settle
+            best = 0.0
+            for _ in range(2):
+                t0 = time.perf_counter()
+                learner.train(steps)
+                best = max(best, steps / (time.perf_counter() - t0))
+            out[f"{label}_steps_per_sec"] = round(best, 2)
+        finally:
+            if learner is not None and learner._snap_engine is not None:
+                learner._snap_engine.stop()
+            stop.set()
+            if feeder is not None:
+                feeder.join(timeout=2.0)
+            if agg is not None:
+                agg.stop()
+        if label == "on":
+            snap = telemetry.get_registry().snapshot()
+            out["snapshots_merged"] = snap.get("fleet/snapshots_total", 0.0)
+            out["win_rate_vs_scripted"] = round(
+                snap.get("outcome/win_rate/vs_scripted", 0.0), 4
+            )
+    off, on = out["off_steps_per_sec"], out["on_steps_per_sec"]
+    out["outcome_overhead"] = (
+        round(max(0.0, 1.0 - on / off), 4) if off else 1.0
+    )
+    return out
+
+
 def bench_quantize(config) -> dict:
     """Quantize stage (ISSUE 7): the rollout experience plane, narrow vs f32.
 
@@ -1281,6 +1388,16 @@ def main() -> None:
     except Exception as e:
         fleet = {"error": f"{type(e).__name__}: {e}"}
 
+    # -- outcome stage: game-quality telemetry on vs off (ISSUE 15) ----------
+    try:
+        outcome = bench_outcome(config)
+        # acceptance: outcome_overhead ≤ 0.02 — curve aggregation rides
+        # the fleet tick, never the train thread's hot path; the in-graph
+        # extraction fuses into the rollout program's existing reductions
+        stages["outcome_overhead"] = outcome.get("outcome_overhead", 1.0)
+    except Exception as e:
+        outcome = {"error": f"{type(e).__name__}: {e}"}
+
     # -- quantize stage: narrow-dtype experience plane (ISSUE 7) -------------
     try:
         quantize = bench_quantize(config)
@@ -1327,6 +1444,29 @@ def main() -> None:
     except Exception as e:
         serve = {"error": f"{type(e).__name__}: {e}"}
 
+    # Host/device fingerprint (ISSUE 15): stamped into every BENCH record
+    # so scripts/bench_trajectory.py can tell which cross-record numbers
+    # are comparable — absolute frames/sec only between like hosts,
+    # within-run ratios everywhere.
+    import platform as _platform
+
+    try:
+        from importlib import metadata as _im
+
+        libtpu_version = _im.version("libtpu")
+    except Exception:  # noqa: BLE001 - absent on CPU hosts
+        libtpu_version = None
+    host_fingerprint = {
+        "platform": _platform.platform(),
+        "python": _platform.python_version(),
+        "device_kind": jax.devices()[0].device_kind,
+        "device_count": len(jax.devices()),
+        "forced_host": "xla_force_host_platform_device_count"
+        in os.environ.get("XLA_FLAGS", ""),
+        "jax": jax.__version__,
+        "libtpu": libtpu_version,
+    }
+
     anchor = None
     if os.path.exists(ANCHOR_PATH):
         try:
@@ -1358,11 +1498,13 @@ def main() -> None:
                 "fused_k8_frames_per_sec": round(k8_fps, 1),
                 "actor_frames_per_sec": round(actor_fps, 1),
                 "stages": stages,
+                "host": host_fingerprint,
                 "transport": transport,
                 "stall": stall,
                 "health": health,
                 "trace": trace,
                 "fleet": fleet,
+                "outcome": outcome,
                 "quantize": quantize,
                 "advantage": advantage,
                 "multichip": multichip,

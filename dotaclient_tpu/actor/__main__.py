@@ -116,6 +116,13 @@ def main(argv=None) -> int:
     p.add_argument("--n-envs", type=int, default=64)
     p.add_argument("--opponent", type=str, default="scripted_easy")
     p.add_argument("--team-size", type=int, default=1)
+    p.add_argument("--max-dota-time", type=float, default=None,
+                   help="episode horizon in game seconds (timeout "
+                        "adjudication decides unfinished games); default "
+                        "EnvConfig.max_dota_time. Short horizons make "
+                        "episode OUTCOMES (the ISSUE 15 plane) arrive at "
+                        "the learner quickly — the chaos outcome scenario "
+                        "relies on it")
     p.add_argument("--rollout-len", type=int, default=None,
                    help="chunk length T; MUST match the learner's "
                         "ppo.rollout_len (e.g. 8 for a --smoke learner) — "
@@ -219,12 +226,14 @@ def main(argv=None) -> int:
     )
 
     config = default_config()
+    env_over = dict(
+        n_envs=args.n_envs, opponent=args.opponent,
+        team_size=args.team_size,
+    )
+    if args.max_dota_time is not None:
+        env_over["max_dota_time"] = args.max_dota_time
     config = dataclasses.replace(
-        config,
-        env=dataclasses.replace(
-            config.env, n_envs=args.n_envs, opponent=args.opponent,
-            team_size=args.team_size,
-        ),
+        config, env=dataclasses.replace(config.env, **env_over)
     )
     if args.rollout_len is not None:
         config = dataclasses.replace(

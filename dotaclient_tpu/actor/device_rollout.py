@@ -32,10 +32,13 @@ from dotaclient_tpu.envs import jax_lane_sim as sim_mod
 from dotaclient_tpu.envs.vec_lane_sim import VecSimSpec, draft_games
 from dotaclient_tpu.features.jax_featurizer import (
     JaxFeaturizer,
-    shaped_rewards,
+    shaped_reward_terms,
 )
+from dotaclient_tpu.features.reward import fold_terms
 from dotaclient_tpu.models import distributions as D
 from dotaclient_tpu.models.policy import Policy, mask_carry
+from dotaclient_tpu.outcome import ingraph as outcome_ingraph
+from dotaclient_tpu.outcome import records as outcome_records
 from dotaclient_tpu.protos import dota_pb2 as pb
 from dotaclient_tpu.utils import telemetry
 
@@ -48,8 +51,11 @@ class DeviceActorState(NamedTuple):
     opp_carry: Tuple[jnp.ndarray, jnp.ndarray]   # opponent lanes' (or dummy)
     key: jnp.ndarray
     ep_return: jnp.ndarray                       # f32 [L] running episode return
+    # i32 [N] env steps into each game's CURRENT episode (outcome plane:
+    # episode length at the done site, reset in-scan)
+    ep_steps: jnp.ndarray
     # cumulative episode stats, accumulated IN the rollout program so a
-    # drain fetches 4 scalars however many chunks were collected
+    # drain fetches a few scalars however many chunks were collected
     stats: Dict[str, jnp.ndarray]
 
 
@@ -139,7 +145,17 @@ class DeviceActor:
             opp_carry=policy.initial_state(opp_lanes),
             key=key,
             ep_return=jnp.zeros((self.n_lanes,), jnp.float32),
+            ep_steps=jnp.zeros((N,), jnp.int32),
             stats=self._zero_stats(),
+        )
+        # Outcome plane (ISSUE 15): static per-game opponent-bucket masks
+        # for the in-graph done-masked reductions, and the owner side the
+        # drained stats attribute to.
+        self._outcome_masks = outcome_ingraph.bucket_masks(
+            N, config.env.opponent, self.n_anchor_games
+        )
+        self._owner_side = (
+            "radiant" if learner_players[0] < config.env.team_size else "dire"
         )
         # Quantized experience plane (ISSUE 7): chunks bound for the
         # trajectory buffer narrow to the wire dtypes INSIDE the jitted
@@ -193,6 +209,7 @@ class DeviceActor:
         self._reward_sum = 0.0
         self._ep_count_window = 0.0
         self._tel = registry if registry is not None else telemetry.get_registry()
+        outcome_records.ensure_actor_metrics(self._tel)
 
     def reset_recurrent(self) -> None:
         """Zero every lane's recurrent carry (learner + opponent sides).
@@ -212,10 +229,17 @@ class DeviceActor:
     @staticmethod
     def _zero_stats() -> Dict[str, jnp.ndarray]:
         z = jnp.zeros((), jnp.float32)
-        return {
+        out = {
             "episodes": z, "wins": z, "reward_sum": z, "ep_return_sum": z,
             "league_episodes": z, "league_wins": z,
         }
+        # outcome plane (ISSUE 15): per-bucket episode outcomes, episode
+        # lengths (+ pow2 histogram), and the per-term reward sums
+        out.update(outcome_ingraph.zero_outcome_stats())
+        out["out_reward_terms"] = {
+            term: z for term in outcome_records.REWARD_TERMS
+        }
+        return out
 
     # -- the jitted chunk generator ---------------------------------------
 
@@ -241,7 +265,7 @@ class DeviceActor:
         )
 
         def body(c, _):
-            sim, lstm, opp_lstm, key, ep_ret = c
+            sim, lstm, opp_lstm, key, ep_ret, ep_steps = c
             key, k_act, k_opp = jax.random.split(key, 3)
 
             obs = feat.featurize(sim)
@@ -281,13 +305,21 @@ class DeviceActor:
                     or self.n_anchor_games > 0
                 ),
             )
-            r = shaped_rewards(
+            r_terms = shaped_reward_terms(
                 spec, self.learner_players, sim, sim2,
                 weights=cfg.reward.as_dict(),
             )
+            # the single-sourced table-order fold: bit-identical to the
+            # historical shaped_rewards sum (features.reward.fold_terms)
+            r = fold_terms(r_terms)
             done_g = sim2.done
             win_g = done_g & (sim2.winning_team == owner_team)
             ep_ret = ep_ret + r
+            # outcome plane: this step closed the episode at length
+            # ep_steps+1 for done games; the counter resets in-scan
+            ep_steps2 = ep_steps + 1
+            ep_len_g = jnp.where(done_g, ep_steps2, 0)
+            ep_steps3 = jnp.where(done_g, 0, ep_steps2)
 
             sim3 = sim_mod.reset_where(spec, sim2, done_g)
             done_lane = jnp.repeat(done_g, A)
@@ -311,14 +343,22 @@ class DeviceActor:
                 "done_lane": done_lane.astype(jnp.float32),
                 "ep_done": done_g,
                 "win": win_g,
+                "ep_len": ep_len_g,
                 "ep_return": jnp.where(done_g, owner_ret, 0.0),
+                # per-term reward sums over the learner lanes (scalars)
+                "rew_terms": {
+                    term: arr.sum() for term, arr in r_terms.items()
+                },
             }
             ep_ret = jnp.where(done_lane, 0.0, ep_ret)
-            return (sim3, lstm3, opp_lstm3, key, ep_ret), out
+            return (sim3, lstm3, opp_lstm3, key, ep_ret, ep_steps3), out
 
-        (sim_f, lstm_f, opp_f, key_f, ep_ret_f), outs = jax.lax.scan(
+        (sim_f, lstm_f, opp_f, key_f, ep_ret_f, ep_steps_f), outs = jax.lax.scan(
             body,
-            (state.sim, state.carry, state.opp_carry, state.key, state.ep_return),
+            (
+                state.sim, state.carry, state.opp_carry, state.key,
+                state.ep_return, state.ep_steps,
+            ),
             None,
             length=T,
         )
@@ -353,10 +393,25 @@ class DeviceActor:
             "league_episodes": (outs["ep_done"] & lg).sum().astype(jnp.float32),
             "league_wins": (outs["win"] & lg).sum().astype(jnp.float32),
         }
-        cum_stats = {k: state.stats[k] + stats[k] for k in stats}
+        # outcome plane (ISSUE 15): done-masked per-bucket reductions +
+        # episode-length histogram + the per-term reward decomposition —
+        # all accumulated on device, drained with the existing stats sync
+        stats.update(
+            outcome_ingraph.chunk_outcome_stats(
+                outs["ep_done"], outs["win"], outs["ep_len"],
+                self._outcome_masks,
+            )
+        )
+        stats["out_reward_terms"] = {
+            term: outs["rew_terms"][term].sum()
+            for term in outcome_records.REWARD_TERMS
+        }
+        cum_stats = jax.tree.map(
+            lambda a, b: a + b, state.stats, stats
+        )
         new_state = DeviceActorState(
             sim=sim_f, carry=lstm_f, opp_carry=opp_f, key=key_f,
-            ep_return=ep_ret_f, stats=cum_stats,
+            ep_return=ep_ret_f, ep_steps=ep_steps_f, stats=cum_stats,
         )
         return new_state, chunk, stats
 
@@ -411,6 +466,11 @@ class DeviceActor:
             self.wins += int(s["wins"])
             self._reward_sum += float(s["ep_return_sum"])
             self._ep_count_window += float(s["episodes"])
+            # outcome plane: the drained window's in-graph reductions land
+            # in the same outcome/ counters the host pools increment
+            outcome_records.fold_device_stats(
+                self._tel, s, owner_side=self._owner_side
+            )
             # windowed (since previous drain) — the responsive learning signal
             self._recent = {
                 "episodes": float(s["episodes"]),

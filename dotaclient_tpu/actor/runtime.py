@@ -43,6 +43,7 @@ import numpy as np
 
 from dotaclient_tpu.actor.window_stats import WindowedStatsMixin
 from dotaclient_tpu.config import RunConfig
+from dotaclient_tpu.outcome import records as outcome_records
 from dotaclient_tpu.utils import telemetry
 from dotaclient_tpu.envs.env_api import LocalDotaEnv
 from dotaclient_tpu.envs import lane_sim
@@ -161,6 +162,11 @@ class ActorPool(WindowedStatsMixin):
         self.envs: List[LocalDotaEnv] = [
             env_factory() for _ in range(config.env.n_envs)
         ]
+        # per-env episode length in env steps (outcome plane, ISSUE 15)
+        self._ep_env_steps: List[int] = [0] * config.env.n_envs
+        self._outcome_bucket = outcome_records.opponent_bucket(
+            config.env.opponent
+        )
         self.lanes: List[_Lane] = []
         for i, env in enumerate(self.envs):
             self._reset_env(i, env)
@@ -188,6 +194,8 @@ class ActorPool(WindowedStatsMixin):
         self.episode_rewards: List[float] = []
         self.wins = 0
         self._tel = telemetry.get_registry()
+        # outcome counters exist (zeroed) from the first fleet snapshot on
+        outcome_records.ensure_actor_metrics(self._tel)
 
     # -- env / lane lifecycle ---------------------------------------------
 
@@ -206,6 +214,7 @@ class ActorPool(WindowedStatsMixin):
     def _reset_env(self, env_idx: int, env: LocalDotaEnv) -> None:
         game_cfg = build_game_config(self.config, self._next_game_seed)
         self._next_game_seed += 1
+        self._ep_env_steps[env_idx] = 0
         init = env.reset(game_cfg)
         assert init.status == pb.STATUS_OK
         # Lanes for this env: every agent-controlled hero.
@@ -355,14 +364,21 @@ class ActorPool(WindowedStatsMixin):
         # Observe, reward, detect episode/chunk boundaries.
         T = self.config.ppo.rollout_len
         finished: List[Tuple[int, _Lane, bool]] = []
+        # every env advances one observation per pool step (episode-length
+        # accounting for the outcome plane)
+        for e in range(len(self.envs)):
+            self._ep_env_steps[e] += 1
+        step_terms: Dict[str, float] = {}
         for i, lane in enumerate(self.lanes):
             env = self.envs[lane.env_idx]
             resp = env.observe(lane.team_id)
             ws = resp.world_state
-            r, _ = shaped_reward(
+            r, terms = shaped_reward(
                 lane.prev_ws, ws, lane.player_id,
                 weights=self._reward_weights,
             )
+            for term, tv in terms.items():
+                step_terms[term] = step_terms.get(term, 0.0) + tv
             done = env.done
             lane.rewards.append(r)
             lane.dones.append(1.0 if done else 0.0)
@@ -379,6 +395,7 @@ class ActorPool(WindowedStatsMixin):
                 finished.append((i, lane, done))
             if done and lane is self._env_owner(lane.env_idx):
                 self._on_episode_end(lane.env_idx, ws)
+        outcome_records.add_reward_terms(self._tel, step_terms)
 
         if finished:
             H = self.config.model.hidden_dim
@@ -407,8 +424,20 @@ class ActorPool(WindowedStatsMixin):
         self.episodes_done += 1
         owner = self._env_owner(env_idx)
         self.episode_rewards.append(owner.episode_reward)
-        if ws.winning_team == owner.team_id:
+        won = ws.winning_team == owner.team_id
+        if won:
             self.wins += 1
+        self.record_episode_outcome(
+            self._outcome_bucket,
+            won,
+            self._ep_env_steps[env_idx],
+            side=(
+                "radiant"
+                if owner.team_id == lane_sim.TEAM_RADIANT
+                else "dire"
+            ),
+            registry=self._tel,
+        )
 
     def _finish_chunk(self, lane_idx: int, lane: _Lane) -> None:
         """Pad, pack, and ship one rollout chunk."""

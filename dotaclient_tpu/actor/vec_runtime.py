@@ -26,6 +26,7 @@ import numpy as np
 
 from dotaclient_tpu.actor.window_stats import WindowedStatsMixin
 from dotaclient_tpu.config import RunConfig
+from dotaclient_tpu.outcome import records as outcome_records
 from dotaclient_tpu.utils import faults, fleet, telemetry, tracing
 from dotaclient_tpu.envs.vec_lane_sim import (
     OPPONENT_CONTROL,
@@ -168,6 +169,12 @@ class VecActorPool(WindowedStatsMixin):
         self.episode_rewards: List[float] = []
         self.wins = 0
         self._tel = telemetry.get_registry()
+        # Outcome plane (ISSUE 15): per-game episode-length accounting +
+        # the opponent bucket this pool's games attribute to; counters
+        # eager-created so the first fleet snapshot ships the zeroed set.
+        outcome_records.ensure_actor_metrics(self._tel)
+        self._outcome_bucket = outcome_records.opponent_bucket(env.opponent)
+        self._ep_game_steps = np.zeros((N,), np.int64)
         self._faults = faults.get()   # None unless chaos injection is on
         # Fleet-health publisher (ISSUE 13): captured ONCE like the fault
         # registry and the tracer — with the fanout off (in-proc pools,
@@ -295,6 +302,12 @@ class VecActorPool(WindowedStatsMixin):
         self.sim.step(sim_actions)
 
         r = self.rewards.compute()                                 # [L]
+        # outcome plane: every live game advanced one env step, and the
+        # step's weighted per-term reward sums feed the decomposition
+        self._ep_game_steps += 1
+        outcome_records.add_reward_terms(
+            self._tel, self.rewards.last_term_sums
+        )
         done_game = self.sim.done                                  # [N]
         A = len(self.feat.agent_players)
         done_lane = np.repeat(done_game, A)                        # [L]
@@ -443,14 +456,33 @@ class VecActorPool(WindowedStatsMixin):
         self.rollouts_shipped += len(out)
 
     def _record_episodes(self, games: np.ndarray) -> None:
+        from dotaclient_tpu.envs.lane_sim import TEAM_RADIANT
+
         A = len(self.feat.agent_players)
         owner_team = self.sim.player_team(int(self.feat.agent_players[0]))
+        side = "radiant" if owner_team == TEAM_RADIANT else "dire"
         for g in games:
             self.episodes_done += 1
             owner_lane = int(g) * A
             self.episode_rewards.append(float(self._lane_reward[owner_lane]))
-            if int(self.sim.winning_team[g]) == owner_team:
+            won = int(self.sim.winning_team[g]) == owner_team
+            if won:
                 self.wins += 1
+            # anchor games (the first n_anchor_games) played a scripted
+            # bot regardless of the pool's nominal opponent mode
+            bucket = (
+                "vs_scripted"
+                if int(g) < self.n_anchor_games
+                else self._outcome_bucket
+            )
+            self.record_episode_outcome(
+                bucket,
+                won,
+                int(self._ep_game_steps[g]),
+                side=side,
+                registry=self._tel,
+            )
+            self._ep_game_steps[int(g)] = 0
             self._lane_reward[int(g) * A:(int(g) + 1) * A] = 0.0
 
     # -- driving -----------------------------------------------------------

@@ -6,22 +6,55 @@ episode counters on the host, so their window is just a delta against the
 counters at the previous drain. Same drain cadence, same ``*_recent`` keys —
 which is what lets the learner's best-model checkpointing
 (``Learner._maybe_save_best``) work identically across all actor modes.
+
+Outcome attribution (ISSUE 15): the episode-end sites the pools already
+own are ALSO where per-opponent game-quality telemetry is born, so this
+mixin is the host-actor half of the outcome plane's extraction layer —
+``record_episode_outcome`` lands one completed episode's outcome (bucket,
+win, length, side) in the process telemetry registry's ``outcome/``
+counters, where external actors' fleet snapshots pick it up
+(``utils/fleet.py``) and in-process modes feed the learner's
+``OutcomeAggregator`` directly. The device path mirrors the same schema
+via in-graph reductions (``outcome/ingraph.py``).
 """
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, Optional
+
+from dotaclient_tpu.outcome import records as outcome_records
+from dotaclient_tpu.utils import telemetry
 
 
 class WindowedStatsMixin:
     """Mixin over a pool exposing ``episodes_done``/``wins`` counters and an
     append-only ``episode_rewards`` list. Provides ``drain_stats()`` and the
-    windowed entries merged into ``stats()`` via ``windowed_entries()``."""
+    windowed entries merged into ``stats()`` via ``windowed_entries()``,
+    plus the outcome-plane episode recording hook."""
 
     # set lazily so __init__ orders don't matter
     _win_base_eps = 0
     _win_base_wins = 0
     _win_base_ret_idx = 0
+
+    def record_episode_outcome(
+        self,
+        bucket: str,
+        won: bool,
+        ep_len_steps: float,
+        side: str = "radiant",
+        registry: Optional[telemetry.Registry] = None,
+    ) -> None:
+        """One completed episode → the ``outcome/`` registry counters
+        (owner-lane convention: call once per finished game, at the same
+        site that bumps ``episodes_done``/``wins``)."""
+        outcome_records.record_episode(
+            registry if registry is not None else telemetry.get_registry(),
+            bucket,
+            won,
+            ep_len_steps,
+            side,
+        )
 
     def drain_stats(self) -> Dict[str, float]:
         """Close the current window (since the previous drain) and return

@@ -228,17 +228,22 @@ class JaxFeaturizer:
         }
 
 
-def shaped_rewards(
+def shaped_reward_terms(
     spec: VecSimSpec,
     agent_players: Sequence[int],
     prev: SimState,
     cur: SimState,
     weights=None,
-) -> jnp.ndarray:
-    """Per-lane shaped reward [L] for the prev→cur interval (jnp port of
-    ``VecRewards``; same components as ``features.reward``; ``weights``
-    overrides the default table — Python floats, so they are compile-time
-    constants)."""
+):
+    """Weighted per-term shaped-reward breakdown, each term a per-lane
+    [L] array (jnp port of ``VecRewards``; same components as
+    ``features.reward``; ``weights`` overrides the default table —
+    Python floats, so they are compile-time constants). The dict is in
+    the historical summation order — :func:`shaped_rewards` left-folds
+    it, so the scalar reward is bit-identical to the pre-decomposition
+    chain — and the per-term sums are what the device rollout
+    accumulates for the outcome plane's reward decomposition
+    (``outcome/reward_sum/<term>``, ISSUE 15)."""
     WEIGHTS = _DEFAULT_WEIGHTS if weights is None else weights
     P = spec.n_players
     ap = jnp.asarray(tuple(int(p) for p in agent_players), jnp.int32)
@@ -290,19 +295,40 @@ def shaped_rewards(
     hp0 = hero_hp_frac(prev)[:, ap]
     hp1 = hero_hp_frac(cur)[:, ap]
 
-    r = (
-        WEIGHTS["xp"] * d("xp")
-        + WEIGHTS["gold"] * d("gold")
-        + WEIGHTS["hp"] * (hp1 - hp0)
-        + WEIGHTS["enemy_hp"] * -(e_hp1 - e_hp0)
-        + WEIGHTS["last_hits"] * d("last_hits")
-        + WEIGHTS["denies"] * d("denies")
-        + WEIGHTS["kills"] * d("kills")
-        + WEIGHTS["deaths"] * d("deaths")
-        + WEIGHTS["tower_damage"] * (e_tw0 - e_tw1)
-        + WEIGHTS["own_tower"] * (o_tw1 - o_tw0)
-    )
     just_ended = cur.done & ~prev.done & (cur.winning_team != 0)
     win_sign = jnp.where(cur.winning_team[:, None] == my_team, 1.0, -1.0)
-    r = r + WEIGHTS["win"] * win_sign * just_ended[:, None]
-    return r.reshape(-1).astype(jnp.float32)
+    terms = {
+        "xp": WEIGHTS["xp"] * d("xp"),
+        "gold": WEIGHTS["gold"] * d("gold"),
+        "hp": WEIGHTS["hp"] * (hp1 - hp0),
+        "enemy_hp": WEIGHTS["enemy_hp"] * -(e_hp1 - e_hp0),
+        "last_hits": WEIGHTS["last_hits"] * d("last_hits"),
+        "denies": WEIGHTS["denies"] * d("denies"),
+        "kills": WEIGHTS["kills"] * d("kills"),
+        "deaths": WEIGHTS["deaths"] * d("deaths"),
+        "tower_damage": WEIGHTS["tower_damage"] * (e_tw0 - e_tw1),
+        "own_tower": WEIGHTS["own_tower"] * (o_tw1 - o_tw0),
+        "win": WEIGHTS["win"] * win_sign * just_ended[:, None],
+    }
+    return {
+        term: arr.reshape(-1).astype(jnp.float32)
+        for term, arr in terms.items()
+    }
+
+
+def shaped_rewards(
+    spec: VecSimSpec,
+    agent_players: Sequence[int],
+    prev: SimState,
+    cur: SimState,
+    weights=None,
+) -> jnp.ndarray:
+    """Per-lane shaped reward [L]: the left-fold of
+    :func:`shaped_reward_terms` in table order (``features.reward.
+    fold_terms`` — bit-identical to the historical single-expression
+    sum)."""
+    from dotaclient_tpu.features.reward import fold_terms
+
+    return fold_terms(
+        shaped_reward_terms(spec, agent_players, prev, cur, weights)
+    )

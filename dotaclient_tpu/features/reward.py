@@ -91,6 +91,21 @@ def reward_components(
     return comps
 
 
+def fold_terms(weighted: Dict[str, object]):
+    """Left-fold a weighted per-term breakdown in table (insertion)
+    order — THE summation-order contract of the reward decomposition
+    (ISSUE 15): every producer (the numpy ``VecRewards``, the jnp
+    ``shaped_reward_terms``, the device rollout body) folds through this
+    one helper, so the scalar reward stays BIT-IDENTICAL to the
+    historical single-expression sum and the device-vs-host parity pins
+    cannot be broken by restructuring one copy of the fold. Works on any
+    ``+``-able values (floats, numpy, jnp arrays)."""
+    total = None
+    for arr in weighted.values():
+        total = arr if total is None else total + arr
+    return total
+
+
 def shaped_reward(
     prev: pb.WorldState,
     cur: pb.WorldState,

@@ -31,7 +31,7 @@ from dotaclient_tpu.envs.lane_sim import (
 )
 from dotaclient_tpu.envs.vec_lane_sim import VecLaneSim
 from dotaclient_tpu.features import featurizer as F
-from dotaclient_tpu.features.reward import WEIGHTS
+from dotaclient_tpu.features.reward import WEIGHTS, fold_terms
 from dotaclient_tpu.protos import dota_pb2 as pb
 
 
@@ -254,6 +254,10 @@ class VecRewards:
         self.sim = sim
         self.agent_players = np.asarray(agent_players, np.int64)
         self.weights = dict(WEIGHTS if weights is None else weights)
+        # last compute()'s weighted per-term sums (outcome decomposition)
+        self.last_term_sums: Dict[str, float] = {
+            t: 0.0 for t in self.weights
+        }
         self.snapshot()
 
     def _state(self) -> Dict[str, np.ndarray]:
@@ -327,24 +331,34 @@ class VecRewards:
         own_tower_cur = np.where(i_rad, cur["tower"][:, 0:1], cur["tower"][:, 1:2])
 
         WEIGHTS = self.weights
-        r = (
-            WEIGHTS["xp"] * (cur["xp"] - prev["xp"])
-            + WEIGHTS["gold"] * (cur["gold"] - prev["gold"])
-            + WEIGHTS["hp"] * (cur["hp"] - prev["hp"])
-            + WEIGHTS["enemy_hp"] * -(enemy_hp_cur - enemy_hp_prev)
-            + WEIGHTS["last_hits"] * (cur["last_hits"] - prev["last_hits"])
-            + WEIGHTS["denies"] * (cur["denies"] - prev["denies"])
-            + WEIGHTS["kills"] * (cur["kills"] - prev["kills"])
-            + WEIGHTS["deaths"] * (cur["deaths"] - prev["deaths"])
-            + WEIGHTS["tower_damage"] * (enemy_tower_prev - enemy_tower_cur)
-            + WEIGHTS["own_tower"] * (own_tower_cur - own_tower_prev)
-        )
         # only the step the game ends pays the win term (done stays True
         # until the runtime resets the game)
         just_ended = sim.done & ~prev["done"] & (sim.winning_team != 0)
         win_sign = np.where(
             sim.winning_team[:, None] == my_team, 1.0, -1.0
         )
-        r = r + WEIGHTS["win"] * win_sign * just_ended[:, None]
+        # weighted per-term breakdown, summed in the historical term
+        # order; the per-term sums feed the outcome plane's reward
+        # decomposition (outcome/reward_sum/<term>)
+        weighted = {
+            "xp": WEIGHTS["xp"] * (cur["xp"] - prev["xp"]),
+            "gold": WEIGHTS["gold"] * (cur["gold"] - prev["gold"]),
+            "hp": WEIGHTS["hp"] * (cur["hp"] - prev["hp"]),
+            "enemy_hp": WEIGHTS["enemy_hp"] * -(enemy_hp_cur - enemy_hp_prev),
+            "last_hits": WEIGHTS["last_hits"]
+            * (cur["last_hits"] - prev["last_hits"]),
+            "denies": WEIGHTS["denies"] * (cur["denies"] - prev["denies"]),
+            "kills": WEIGHTS["kills"] * (cur["kills"] - prev["kills"]),
+            "deaths": WEIGHTS["deaths"] * (cur["deaths"] - prev["deaths"]),
+            "tower_damage": WEIGHTS["tower_damage"]
+            * (enemy_tower_prev - enemy_tower_cur),
+            "own_tower": WEIGHTS["own_tower"]
+            * (own_tower_cur - own_tower_prev),
+            "win": WEIGHTS["win"] * win_sign * just_ended[:, None],
+        }
+        r = fold_terms(weighted)
+        self.last_term_sums = {
+            term: float(arr.sum()) for term, arr in weighted.items()
+        }
         self._prev = cur
         return r.reshape(-1).astype(np.float32)

@@ -20,7 +20,12 @@ telemetry registry):
 * waivers must be live: a waived anchor that no longer exists in the
   doc, or that a rule now covers, is stale and fails;
 * the "Alert catalog" table mirrors the rule table row-for-row: every
-  rule has a catalog row, every catalog row names a real rule.
+  rule has a catalog row, every catalog row names a real rule;
+* (ISSUE 15) every rule's ``key=`` must name a telemetry key SOME code
+  actually emits (statically extracted, the telemetry-drift machinery) —
+  a rule watching a renamed or never-wired key is silent forever, which
+  is worse than no rule: the runbook row reads as covered. Pattern keys
+  (``fleet/*/...``) are exempt — their members are runtime peer labels.
 
 Rule fields must be LITERALS — a computed ``runbook=`` escapes the
 cross-check and is flagged as not statically checkable.
@@ -185,6 +190,34 @@ def catalog_rule_names(doc: str) -> Dict[str, int]:
     return out
 
 
+def rule_key_findings(
+    rules: List[Dict[str, object]],
+    emitted: "set[str]",
+    rule_id: str = "alert-drift",
+) -> List[Diagnostic]:
+    """Every non-pattern rule key must be an emitted telemetry key
+    (ISSUE 15): a rule over a ghost key can never fire, silently
+    un-watching its runbook row."""
+    out: List[Diagnostic] = []
+    for r in rules:
+        key = r.get("key")
+        if not isinstance(key, str):
+            continue
+        if any(ch in key for ch in "*?["):
+            continue  # runtime-labeled families (per-peer mirrors)
+        if key not in emitted:
+            out.append(
+                Diagnostic(
+                    ALERTS_PY, int(r["line"]), rule_id,  # type: ignore[arg-type]
+                    f"rule {r['name']!r} watches telemetry key {key!r} "
+                    f"but no emission site exists in the package — the "
+                    f"rule can never fire; fix the key or the emitter",
+                    context=key,
+                )
+            )
+    return out
+
+
 # -- the cross-check ----------------------------------------------------------
 
 
@@ -294,9 +327,15 @@ class AlertDriftRule(Rule):
     )
 
     def paths(self) -> Iterable[str]:
-        return [ALERTS_PY, OPERATIONS_MD]
+        from dotaclient_tpu.lint.core import package_py_files
+
+        # the whole package: rule keys are validated against the
+        # statically-extracted emitted-key set (rule_key_findings)
+        return [ALERTS_PY, OPERATIONS_MD] + package_py_files()
 
     def check(self, files: Dict[str, FileCtx]) -> List[Diagnostic]:
+        from dotaclient_tpu.lint.telemetry_drift import extract_emitted
+
         alerts = files.get(ALERTS_PY)
         doc = files.get(OPERATIONS_MD)
         if alerts is None or alerts.tree is None:
@@ -309,4 +348,8 @@ class AlertDriftRule(Rule):
                 rules, waivers, doc.source if doc is not None else "", self.id
             )
         )
+        # unresolvable-emission diagnostics belong to telemetry-drift;
+        # here the extraction only feeds the rule-key existence check
+        emitted, _sites, _problems = extract_emitted(files)
+        out.extend(rule_key_findings(rules, emitted, self.id))
         return out

@@ -77,6 +77,14 @@ ALLOWED_FUNCS: Dict[str, Set[str]] = {
     # serialize every consumed batch behind device compute); no
     # function-level pass.
     "dotaclient_tpu/train/advantage.py": set(),
+    # Outcome attribution plane (ISSUE 15): the aggregator tick runs on
+    # the train thread at log boundaries (in-proc modes) and on the fleet
+    # aggregator thread (external modes) — it must stay pure host
+    # registry arithmetic, and the recording helpers run at actor episode
+    # boundaries / stats-drain folds with ALREADY-fetched host scalars;
+    # every sync-shaped cast is annotated at the line.
+    "dotaclient_tpu/outcome/aggregator.py": {"__init__"},
+    "dotaclient_tpu/outcome/records.py": set(),
     # The snapshot engine IS the designated sync site (ISSUE 5): its one
     # batched fetch is annotated at the line, everything else must stay
     # host-only — no function-level pass.

@@ -280,7 +280,29 @@ OWNERSHIP: Dict[str, Dict[str, ClassMap]] = {
                 "_peers": "agg",
                 "_engine": "agg",
                 "_thread": "learner",
+                # registered by the learner BEFORE start() and only read
+                # by the aggregator thread afterwards (handoff-by-start
+                # contract documented at the attribute)
+                "_tick_hooks": "any",
             },
+        ),
+    },
+    "dotaclient_tpu/outcome/aggregator.py": {
+        # Outcome attribution plane (ISSUE 15): tick() has MODAL callers —
+        # the fleet aggregator's thread in external-transport modes, the
+        # train thread at log boundaries in the in-process modes — so the
+        # window state is lock-guarded rather than thread-owned; every
+        # other consumer reads the published gauges through the
+        # thread-safe telemetry registry.
+        "OutcomeAggregator": ClassMap(
+            default_thread="any",
+            attrs={
+                "_samples": "lock:_lock",
+                "_armed": "lock:_lock",
+                "_last_total_eps": "lock:_lock",
+                "_last_episode_t": "lock:_lock",
+            },
+            holds={"_publish": ("_lock",), "_total_eps": ("_lock",)},
         ),
     },
     "dotaclient_tpu/transport/shm_transport.py": {

@@ -91,6 +91,31 @@ DYNAMIC_KEY_EXPANSIONS: Dict[Tuple[str, str], Tuple[str, ...]] = {
     ("fleet/", ""): (
         "a0/actor/env_steps", "a0/env_fps",
     ),
+    # Outcome attribution plane (ISSUE 15; dotaclient_tpu/outcome/).
+    # Keep the value tuples in sync with outcome.records BUCKETS / SIDES
+    # / REWARD_TERMS / N_LEN_BUCKETS and the OUTCOME_KEYS schema tier.
+    ("outcome/episodes/", ""): (
+        "vs_scripted", "vs_league", "vs_selfplay",
+    ),
+    ("outcome/wins/", ""): (
+        "vs_scripted", "vs_league", "vs_selfplay",
+    ),
+    ("outcome/win_rate/", ""): (
+        "vs_scripted", "vs_league", "overall",
+    ),
+    ("outcome/episodes_side/", ""): ("radiant", "dire"),
+    ("outcome/ep_len_hist/", ""): (
+        "00", "01", "02", "03", "04", "05",
+        "06", "07", "08", "09", "10", "11",
+    ),
+    ("outcome/reward_sum/", ""): (
+        "xp", "gold", "hp", "enemy_hp", "last_hits", "denies", "kills",
+        "deaths", "tower_damage", "own_tower", "win",
+    ),
+    ("outcome/reward/", ""): (
+        "xp", "gold", "hp", "enemy_hp", "last_hits", "denies", "kills",
+        "deaths", "tower_damage", "own_tower", "win",
+    ),
 }
 
 # Token shape of a telemetry key in backticked doc text: slash-separated
@@ -107,8 +132,8 @@ _DOC_KEY_RE = re.compile(
 KEY_PREFIXES = (
     "actor/", "advantage/", "alerts/", "buffer/", "checkpoint/",
     "compile/", "faults/", "fleet/", "health/", "league/", "learner/",
-    "mem/", "mesh/", "serve/", "shm/", "snapshot/", "span/", "trace/",
-    "transport/",
+    "mem/", "mesh/", "outcome/", "serve/", "shm/", "snapshot/", "span/",
+    "trace/", "transport/",
 )
 # single-line inline code only: multi-line matches would mispair across
 # ``` fence lines (odd backtick count flips pairing for the whole doc)

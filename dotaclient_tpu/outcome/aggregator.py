@@ -1,0 +1,200 @@
+"""Learner-side outcome aggregation: counters → windowed curves + alerts.
+
+``OutcomeAggregator.tick()`` is a pure host pass over the telemetry
+registry: it collapses the outcome counter totals (the learner's own
+``outcome/`` counters — in-process actor modes — plus every
+``fleet/<peer>/outcome/...`` mirror the FleetAggregator delta-merged
+from external actors' snapshot frames) into a sliding window and
+publishes the curves as gauges. No thread of its own and no device
+traffic: in external-transport modes the FleetAggregator's tick hook
+drives it at fleet cadence (wall clock — a starved learner still
+evaluates outcome staleness), in the in-process modes the learner ticks
+it at log boundaries. Both callers serialize through ``_lock``, so the
+modal ownership can never race (OWNERSHIP-mapped in lint/ownership.py;
+the whole module is scanned by the host-sync lint pass with no
+allowance).
+
+Published gauges (eager-created at construction — the
+``--require-outcome`` schema tier holds for ANY learner JSONL):
+
+* ``outcome/win_rate/{vs_scripted,vs_league,overall}`` — windowed
+  win-rates, initialized to the 0.5 NEUTRAL PRIOR and only updated once
+  a window holds ``min_episodes`` episodes of that bucket: the
+  ``win_rate_collapse`` alert can then watch the gauge directly without
+  false-firing on runs that play no scripted games at all.
+* ``outcome/episode_len_p50`` — windowed median episode length (env
+  steps), from the power-of-two histogram (2× resolution, the
+  ``telemetry.Timer`` convention); 0 until armed.
+* ``outcome/episode_len_anomaly`` — 1.0 while the armed window's p50
+  sits below ``ep_len_floor`` (degenerate instant-reset episodes: an
+  env/reset bug, not a skill signal); the alert watches this derived
+  binary so the unarmed state can never false-fire.
+* ``outcome/reward/<term>`` — windowed per-episode mean of each weighted
+  shaping term (the reward decomposition: "the policy stopped winning
+  because the tower term collapsed" is readable from the curves).
+* ``outcome/episodes_total`` / ``outcome/episodes_recent`` — lifetime
+  total across sources / episodes inside the current window.
+* ``outcome/stream_age_s`` — seconds since the episode total last
+  advanced, −1 until the FIRST episode ever arrives (arming): the
+  ``outcome_stream_stale`` alert fires only when a previously-live
+  outcome stream stops.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, Optional, Tuple
+
+from dotaclient_tpu.outcome.records import (
+    BUCKETS,
+    N_LEN_BUCKETS,
+    REWARD_TERMS,
+    counter_totals,
+)
+from dotaclient_tpu.utils import telemetry
+
+__all__ = ["OutcomeAggregator"]
+
+# Win-rate gauges: the two attributable buckets plus the overall rate
+# (vs_selfplay alone is ~0.5 by construction and reads from "overall").
+_RATE_BUCKETS = ("vs_scripted", "vs_league", "overall")
+
+
+class OutcomeAggregator:
+    """Windowed outcome curves over the registry's outcome counters."""
+
+    def __init__(
+        self,
+        registry: Optional[telemetry.Registry] = None,
+        window_s: float = 120.0,
+        min_episodes: int = 8,
+        # the pow2-histogram p50 is an upper bound with minimum value 2
+        # (bucket 0's bound), so the floor sits at 4: a bucket-0 median —
+        # single-step episodes — is the degenerate-reset signature
+        ep_len_floor: float = 4.0,
+    ) -> None:
+        self._reg = (
+            registry if registry is not None else telemetry.get_registry()
+        )
+        self.window_s = float(window_s)
+        self.min_episodes = int(min_episodes)
+        self.ep_len_floor = float(ep_len_floor)
+        self._lock = threading.Lock()
+        # (t, totals) samples spanning the window; the oldest retained
+        # sample is the delta baseline
+        self._samples: Deque[Tuple[float, Dict[str, float]]] = deque()
+        self._armed = False
+        self._last_total_eps = 0.0
+        self._last_episode_t = 0.0
+        # eager keys + neutral priors (see module docstring)
+        for bucket in _RATE_BUCKETS:
+            self._reg.gauge(f"outcome/win_rate/{bucket}").set(0.5)
+        self._reg.gauge("outcome/episode_len_p50")
+        self._reg.gauge("outcome/episode_len_anomaly")
+        self._reg.gauge("outcome/stream_age_s").set(-1.0)
+        self._reg.gauge("outcome/episodes_total")
+        self._reg.gauge("outcome/episodes_recent")
+        for term in REWARD_TERMS:
+            self._reg.gauge(f"outcome/reward/{term}")
+
+    # -- the periodic pass --------------------------------------------------
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """One merge + curve-update pass. Host dict arithmetic only —
+        callable from the fleet aggregator's thread (external modes) or
+        the train thread at log boundaries (in-process modes); the lock
+        serializes the modal callers."""
+        if now is None:
+            now = time.monotonic()
+        counters, _ = self._reg.counters_and_gauges()
+        totals = counter_totals(counters)
+        with self._lock:
+            if not self._samples:
+                # empty baseline: the FIRST tick's window covers every
+                # episode since construction — without it, outcomes that
+                # completed before the first tick would be swallowed by
+                # the self-baseline and never enter a curve
+                self._samples.append((now, {}))
+            self._samples.append((now, totals))
+            while (
+                len(self._samples) > 2
+                and now - self._samples[0][0] > self.window_s
+            ):
+                self._samples.popleft()
+            base = self._samples[0][1]
+            delta = {
+                k: totals.get(k, 0.0) - base.get(k, 0.0) for k in totals
+            }
+            self._publish(now, totals, delta)
+
+    def _total_eps(self, d: Dict[str, float]) -> float:
+        return sum(d.get(f"outcome/episodes/{b}", 0.0) for b in BUCKETS)
+
+    def _publish(
+        self,
+        now: float,
+        totals: Dict[str, float],
+        delta: Dict[str, float],
+    ) -> None:
+        total_eps = self._total_eps(totals)
+        d_eps = self._total_eps(delta)
+        self._reg.gauge("outcome/episodes_total").set(total_eps)
+        self._reg.gauge("outcome/episodes_recent").set(d_eps)
+        # stream liveness: armed at the first episode ever observed, age
+        # measured from the last tick that saw the total advance
+        if total_eps > self._last_total_eps or (
+            total_eps > 0 and not self._armed
+        ):
+            self._armed = True
+            self._last_episode_t = now
+        self._last_total_eps = total_eps
+        self._reg.gauge("outcome/stream_age_s").set(
+            now - self._last_episode_t if self._armed else -1.0
+        )
+        # windowed win-rates: updated only once the window carries signal
+        # (the gauges otherwise HOLD — last value, or the 0.5 prior)
+        for bucket in _RATE_BUCKETS:
+            if bucket == "overall":
+                eps, wins = d_eps, sum(
+                    delta.get(f"outcome/wins/{b}", 0.0) for b in BUCKETS
+                )
+            else:
+                eps = delta.get(f"outcome/episodes/{bucket}", 0.0)
+                wins = delta.get(f"outcome/wins/{bucket}", 0.0)
+            if eps >= self.min_episodes:
+                self._reg.gauge(f"outcome/win_rate/{bucket}").set(
+                    wins / eps
+                )
+        # windowed episode-length p50 from the pow2 histogram deltas
+        if d_eps >= self.min_episodes:
+            p50 = self._hist_p50(delta)
+            self._reg.gauge("outcome/episode_len_p50").set(p50)
+            self._reg.gauge("outcome/episode_len_anomaly").set(
+                1.0 if p50 < self.ep_len_floor else 0.0
+            )
+        # reward decomposition: windowed per-episode mean per term
+        if d_eps > 0:
+            for term in REWARD_TERMS:
+                self._reg.gauge(f"outcome/reward/{term}").set(
+                    delta.get(f"outcome/reward_sum/{term}", 0.0) / d_eps
+                )
+
+    @staticmethod
+    def _hist_p50(delta: Dict[str, float]) -> float:
+        counts = [
+            delta.get(f"outcome/ep_len_hist/{i:02d}", 0.0)
+            for i in range(N_LEN_BUCKETS)
+        ]
+        total = sum(counts)
+        if total <= 0:
+            return 0.0
+        target = total / 2.0
+        seen = 0.0
+        for i, c in enumerate(counts):
+            seen += c
+            if seen >= target:
+                # bucket upper bound, the Timer.quantile convention
+                return float(2 ** (i + 1))   # host-sync-ok: host int
+        return float(2 ** N_LEN_BUCKETS)   # host-sync-ok: host int

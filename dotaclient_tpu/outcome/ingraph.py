@@ -1,0 +1,119 @@
+"""In-graph episode-outcome reductions for the device/fused rollout.
+
+The device rollout (and fused mode, which runs the same
+``_rollout_impl`` inside its one donated program) never touches the host
+per chunk, so outcome extraction there must be done-masked reductions
+INSIDE the program, accumulated in the actor's device-resident stats and
+fetched only by the existing decimated stats drain — the Podracer
+constraint the whole plane is designed around (no new host syncs;
+``lint/host_sync.py`` guards the aggregator side).
+
+:func:`chunk_outcome_stats` is the single reduction both the rollout
+program and the parity tests call: given the per-step done/win/length
+streams of one chunk it produces exactly the scalars
+``records.fold_device_stats`` folds into the ``outcome/`` counters —
+pinned bitwise against host-loop recording on the same streams
+(tests/test_outcome.py), the PR 10/11 parity-digest pattern.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+
+from dotaclient_tpu.outcome.records import BUCKETS, N_LEN_BUCKETS
+
+
+def bucket_masks(
+    n_games: int, opponent: str, n_anchor_games: int
+) -> Dict[str, jnp.ndarray]:
+    """Static per-game opponent-bucket masks [N] for one pool config.
+
+    Scripted modes: every game is vs_scripted. Self-play: every game is
+    the mirror. League: the first ``n_anchor_games`` games are pinned to
+    a scripted anchor (``envs.vec_lane_sim.apply_anchor_games`` puts
+    them at the FRONT — the same split ``DeviceActor._league_game_mask``
+    relies on for PFSP attribution), the rest play snapshots.
+    """
+    idx = jnp.arange(n_games)
+    if opponent == "selfplay":
+        scripted = jnp.zeros(n_games, bool)
+        league = jnp.zeros(n_games, bool)
+        selfplay = jnp.ones(n_games, bool)
+    elif opponent == "league":
+        scripted = idx < n_anchor_games
+        league = ~scripted
+        selfplay = jnp.zeros(n_games, bool)
+    else:
+        scripted = jnp.ones(n_games, bool)
+        league = jnp.zeros(n_games, bool)
+        selfplay = jnp.zeros(n_games, bool)
+    return {
+        "vs_scripted": scripted, "vs_league": league, "vs_selfplay": selfplay
+    }
+
+
+def zero_outcome_stats() -> Dict[str, jnp.ndarray]:
+    """The outcome slice of the device actor's stats accumulator."""
+    z = jnp.zeros((), jnp.float32)
+    out: Dict[str, jnp.ndarray] = {}
+    for bucket in BUCKETS:
+        out[f"out_eps_{bucket}"] = z
+        out[f"out_wins_{bucket}"] = z
+    out["out_ep_len_sum"] = z
+    out["out_ep_len_hist"] = jnp.zeros((N_LEN_BUCKETS,), jnp.float32)
+    return out
+
+
+def chunk_outcome_stats(
+    ep_done: jnp.ndarray,
+    win: jnp.ndarray,
+    ep_len: jnp.ndarray,
+    masks: Optional[Dict[str, jnp.ndarray]] = None,
+) -> Dict[str, jnp.ndarray]:
+    """Done-masked outcome reductions over one chunk's episode stream.
+
+    ``ep_done``/``win`` are boolean ``[..., N]`` (any leading step axes),
+    ``ep_len`` the integer episode length in env steps at the done site
+    (0 where not done). ``masks`` are the static per-game bucket masks
+    ([N], broadcast across leading axes); ``None`` buckets everything
+    vs_scripted (the parity tests' single-bucket mode).
+    """
+    done_f = ep_done.astype(jnp.float32)
+    win_f = (win & ep_done).astype(jnp.float32)
+    out: Dict[str, jnp.ndarray] = {}
+    for bucket in BUCKETS:
+        if masks is None:
+            m = (
+                jnp.ones(ep_done.shape[-1], bool)
+                if bucket == "vs_scripted"
+                else jnp.zeros(ep_done.shape[-1], bool)
+            )
+        else:
+            m = masks[bucket]
+        mf = m.astype(jnp.float32)
+        out[f"out_eps_{bucket}"] = (done_f * mf).sum()
+        out[f"out_wins_{bucket}"] = (win_f * mf).sum()
+    lens = ep_len.astype(jnp.float32) * done_f
+    out["out_ep_len_sum"] = lens.sum()
+    # power-of-two bucket index via EXACT integer threshold compares —
+    # idx = #{i >= 1 : len >= 2^i} == bit_length-1 clipped, the host
+    # convention (records.len_bucket). A float log2 formulation would be
+    # 1 ulp from flipping a bucket at exact power-of-two lengths on
+    # backends with approximated transcendentals (TPU) — and timeout-
+    # adjudicated episodes all share ONE exact length, so a single flip
+    # would move every one of them (review finding). Non-done slots are
+    # masked out of the scatter-add by weight 0, so their index never
+    # matters.
+    safe = jnp.maximum(ep_len, 1).astype(jnp.int32)
+    idx = sum(
+        (safe >= (1 << i)).astype(jnp.int32)
+        for i in range(1, N_LEN_BUCKETS)
+    )
+    out["out_ep_len_hist"] = (
+        jnp.zeros((N_LEN_BUCKETS,), jnp.float32)
+        .at[idx.reshape(-1)]
+        .add(done_f.reshape(-1))
+    )
+    return out

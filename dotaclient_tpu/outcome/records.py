@@ -1,0 +1,166 @@
+"""Episode-outcome records: the actor-side counter schema + recording API.
+
+One schema, two producers. Host pools (``ActorPool``/``VecActorPool``)
+call :func:`record_episode` / :func:`add_reward_terms` directly at the
+episode-end / step sites they already own (via the
+``actor/window_stats.py`` mixin); the device/fused rollout accumulates
+the same facts in-graph (``outcome/ingraph.py``) and
+:func:`fold_device_stats` folds the drained stat scalars into these SAME
+counters at the existing stats-drain cadence. Either way the facts land
+as monotone registry counters under ``outcome/``, which
+
+* ride the fleet snapshot frames to the learner from external actors
+  (``utils/fleet.py`` ships the ``outcome/`` namespace; counters are
+  delta-merged per peer, so a supervisor-restarted actor never
+  double-counts), and
+* feed the learner-side ``OutcomeAggregator`` windows locally in the
+  in-process actor modes.
+
+Episode length is counted in ENV STEPS (observation cadence) and
+histogrammed into power-of-two buckets (the ``telemetry.Timer``
+convention: bucket ``i`` covers lengths in ``[2^i, 2^(i+1))``, last
+bucket open-ended) so a cross-process p50 is derivable from shipped
+scalars — a mean alone cannot distinguish "all episodes normal" from
+"half instant, half timeout".
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping
+
+from dotaclient_tpu.config import RewardConfig
+from dotaclient_tpu.utils import telemetry
+
+# Opponent buckets: who the learner-controlled side actually played.
+# "vs_scripted" = a scripted bot (scripted_easy/hard opponents, and league
+# anchor games — the tier-2 honesty metric's denominator), "vs_league" =
+# a frozen snapshot opponent, "vs_selfplay" = the mirror (live params both
+# sides; win-rate ~0.5 by construction, reported for completeness).
+BUCKETS = ("vs_scripted", "vs_league", "vs_selfplay")
+
+SIDES = ("radiant", "dire")
+
+# Reward shaping terms — the RewardConfig field set, in table order.
+REWARD_TERMS = tuple(RewardConfig().as_dict())
+
+# Power-of-two episode-length histogram buckets (env steps). 12 buckets
+# reach 2^11 = 2048+ steps — past any configured max_dota_time horizon.
+N_LEN_BUCKETS = 12
+
+
+def opponent_bucket(opponent: str) -> str:
+    """The outcome bucket a pool's NON-anchor games belong to, from the
+    env opponent mode (league anchor games are bucketed vs_scripted by
+    the callers that know the anchor split)."""
+    if opponent in ("scripted_easy", "scripted_hard"):
+        return "vs_scripted"
+    if opponent == "selfplay":
+        return "vs_selfplay"
+    return "vs_league"
+
+
+def len_bucket(ep_len_steps: float) -> int:
+    """Histogram bucket index for one episode length (env steps)."""
+    n = max(int(ep_len_steps), 1)
+    return min(n.bit_length() - 1, N_LEN_BUCKETS - 1)
+
+
+def ensure_actor_metrics(reg: telemetry.Registry) -> None:
+    """Eager-create every actor-side outcome counter, so fleet snapshots
+    ship the full (zeroed) set from a peer's first frame and
+    ``check_telemetry_schema.py --require-outcome`` validates any learner
+    JSONL deterministically (the Learner calls this at construction in
+    every actor mode)."""
+    for bucket in BUCKETS:
+        reg.counter(f"outcome/episodes/{bucket}")
+        reg.counter(f"outcome/wins/{bucket}")
+    for side in SIDES:
+        reg.counter(f"outcome/episodes_side/{side}")
+    reg.counter("outcome/ep_len_sum")
+    for i in range(N_LEN_BUCKETS):
+        reg.counter(f"outcome/ep_len_hist/{i:02d}")
+    for term in REWARD_TERMS:
+        reg.counter(f"outcome/reward_sum/{term}")
+
+
+def record_episode(
+    reg: telemetry.Registry,
+    bucket: str,
+    won: bool,
+    ep_len_steps: float,
+    side: str = "radiant",
+) -> None:
+    """One completed episode's outcome → the registry counters (host
+    pools' episode-end site; counted once per game, owner-lane
+    convention)."""
+    reg.counter(f"outcome/episodes/{bucket}").inc()
+    if won:
+        reg.counter(f"outcome/wins/{bucket}").inc()
+    reg.counter(f"outcome/episodes_side/{side}").inc()
+    reg.counter("outcome/ep_len_sum").inc(float(max(ep_len_steps, 0.0)))   # host-sync-ok: host scalar (episode length)
+    reg.counter(f"outcome/ep_len_hist/{len_bucket(ep_len_steps):02d}").inc()
+
+
+def add_reward_terms(
+    reg: telemetry.Registry, term_sums: Mapping[str, float]
+) -> None:
+    """Accumulate one step's WEIGHTED per-term reward sums (summed over
+    the pool's learner lanes) into the decomposition counters."""
+    for term, v in term_sums.items():
+        if v:
+            reg.counter(f"outcome/reward_sum/{term}").inc(float(v))   # host-sync-ok: host floats (caller-summed term values)
+
+
+def fold_device_stats(
+    reg: telemetry.Registry,
+    stats: Mapping[str, object],
+    owner_side: str = "radiant",
+) -> None:
+    """Fold one drained device-stats window (``DeviceActor`` /
+    fused-mode in-graph reductions, already fetched to host numpy by the
+    stats drain) into the same counters the host pools increment
+    directly. Runs at stats-drain cadence on whichever thread performed
+    the fetch (the snapshot thread in async mode) — host arithmetic
+    only."""
+    episodes = 0.0
+    for bucket in BUCKETS:
+        eps = float(stats.get(f"out_eps_{bucket}", 0.0))    # host-sync-ok: drained host stats
+        wins = float(stats.get(f"out_wins_{bucket}", 0.0))  # host-sync-ok: drained host stats
+        if eps:
+            reg.counter(f"outcome/episodes/{bucket}").inc(eps)
+            episodes += eps
+        if wins:
+            reg.counter(f"outcome/wins/{bucket}").inc(wins)
+    if episodes:
+        # the device actor's episodes are all owner-side games
+        reg.counter(f"outcome/episodes_side/{owner_side}").inc(episodes)
+    len_sum = float(stats.get("out_ep_len_sum", 0.0))       # host-sync-ok: drained host stats
+    if len_sum:
+        reg.counter("outcome/ep_len_sum").inc(len_sum)
+    hist = stats.get("out_ep_len_hist")
+    if hist is not None:
+        for i in range(N_LEN_BUCKETS):
+            v = float(hist[i])   # host-sync-ok: drained host stats
+            if v:
+                reg.counter(f"outcome/ep_len_hist/{i:02d}").inc(v)
+    terms = stats.get("out_reward_terms")
+    if isinstance(terms, Mapping):
+        add_reward_terms(
+            reg, {t: float(v) for t, v in terms.items()}   # host-sync-ok: drained host stats
+        )
+
+
+def counter_totals(counters: Mapping[str, float]) -> Dict[str, float]:
+    """Collapse a registry counters dict into outcome totals: the
+    learner's own ``outcome/...`` counters plus every fleet per-peer
+    mirror (``fleet/<peer>/outcome/...`` — already delta-merged by the
+    FleetAggregator, so summing across peers is restart-safe)."""
+    totals: Dict[str, float] = {}
+    for name, v in counters.items():
+        if name.startswith("outcome/"):
+            totals[name] = totals.get(name, 0.0) + v
+        elif name.startswith("fleet/") and "/outcome/" in name:
+            suffix = name.split("/outcome/", 1)[1]
+            key = f"outcome/{suffix}"
+            totals[key] = totals.get(key, 0.0) + v
+    return totals

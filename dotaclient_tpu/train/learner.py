@@ -586,8 +586,25 @@ class Learner:
         )
         if transport is not None and hasattr(transport, "metrics_handler"):
             transport.metrics_handler = self.fleet.ingest
+        # Outcome attribution plane (ISSUE 15): eager-create BOTH halves
+        # of the outcome key schema — the actor-side counters (so
+        # `--require-outcome` validates an external learner's JSONL that
+        # only ever sees fleet mirrors) and the aggregator's curve gauges.
+        # The aggregator has no thread of its own: the fleet aggregator's
+        # tick hook drives it at fleet cadence in external modes (wall
+        # clock — outcome staleness evaluates even when training stalls),
+        # and _publish_pipeline_gauges ticks it at log boundaries in the
+        # in-process modes.
+        from dotaclient_tpu.outcome import OutcomeAggregator
+        from dotaclient_tpu.outcome import records as outcome_records
+
+        outcome_records.ensure_actor_metrics(self.telemetry)
+        self.outcome = OutcomeAggregator(registry=self.telemetry)
+        self.fleet.add_tick_hook(self.outcome.tick)
+        self._fleet_started = False
         if mode == "external" and telemetry.fleet_interval_s > 0:
             self.fleet.start()
+            self._fleet_started = True
         self.frames_per_rollout = config.ppo.rollout_len
         # Minibatch machinery: one jitted gather (a tree of row-gathers is
         # otherwise a dispatch per leaf), host RNG for the shuffles, and the
@@ -1390,6 +1407,12 @@ class Learner:
                 # boundary was folded by the engine BEFORE this job ran
                 # (submit_stats ordering), so the accumulators are current
                 scalars.update(stats_source())
+            # outcome curves (ISSUE 15): tick AFTER the stat drain above
+            # folded this window's episodes into the outcome counters, so
+            # the line logged below carries curves consistent with its
+            # own counters (tick is lock-guarded — safe on this thread)
+            if not self._fleet_started:
+                self.outcome.tick()
             scalars.update(host_extra)
             if self._best_dir is not None:
                 # the save itself happens on the train thread at the next
@@ -1447,6 +1470,17 @@ class Learner:
         # device-memory watermark (ISSUE 12): host-only allocator metadata,
         # refreshed at log cadence; CPU backends report none → stays 0
         tracing.update_memory_gauges(self.telemetry)
+        # outcome curves (ISSUE 15): in-process modes tick the windowed
+        # aggregation at log cadence (host counter arithmetic only);
+        # external modes tick from the fleet aggregator thread instead.
+        # This tick keeps the tail/log_files_only snapshot fresh; the
+        # boundary-cadence ticks that feed the JSONL curves run AFTER the
+        # stats drain folds the window's episodes (the async metrics
+        # continuation / the sync branch) — ticking only here would lag
+        # the device/fused curves one full boundary behind the counters
+        # logged on the same line (review finding).
+        if not self._fleet_started:
+            self.outcome.tick()
 
     def train(
         self,
@@ -1568,6 +1602,11 @@ class Learner:
                     self._maybe_save_best(scalars)
                     if self._best_dir is not None:
                         scalars["best_win_rate"] = self._best_win
+                    # outcome curves (ISSUE 15): tick after the drain
+                    # above folded this window's episodes — same-line
+                    # consistency as the async continuation
+                    if not self._fleet_started:
+                        self.outcome.tick()
                     self._last_metrics = self.metrics.log(step, scalars)
                 self._stall_s += time.perf_counter() - t0
                 self.telemetry.gauge("learner/stall_fraction").set(

@@ -119,7 +119,14 @@ def unflatten_tree(flat: Mapping[str, np.ndarray]) -> Dict[str, Any]:
     def fix(node):
         if not isinstance(node, dict):
             return node
-        if node and all(k.isdigit() for k in node):
+        # tuple levels are exactly what flatten_tree emits: UNPADDED
+        # indices 0..n-1. Zero-padded digit keys ("00", "01" — e.g. the
+        # outcome plane's histogram bucket names riding a fleet snapshot)
+        # are ordinary dict keys, not tuple indices; treating them as
+        # indices KeyError'd the whole decode (ISSUE 15 bugfix sweep).
+        if node and all(
+            k.isdigit() and str(int(k)) == k for k in node
+        ) and set(node) == {str(i) for i in range(len(node))}:
             return tuple(fix(node[str(i)]) for i in range(len(node)))
         return {k: fix(v) for k, v in node.items()}
 

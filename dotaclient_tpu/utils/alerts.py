@@ -173,6 +173,35 @@ RULES: Tuple[AlertRule, ...] = (
         runbook="rb:learner-crash",
         summary="fleet-wide reconnect storm: actors losing the learner",
     ),
+    # -- outcome attribution plane (ISSUE 15; dotaclient_tpu/outcome/) --
+    AlertRule(
+        # the gauge initializes to the 0.5 NEUTRAL PRIOR and only moves
+        # once a window holds OutcomeAggregator.min_episodes scripted
+        # games, so runs that play no scripted bot can never false-fire
+        "win_rate_collapse", key="outcome/win_rate/vs_scripted",
+        kind="threshold", op="<", value=0.2, for_s=120.0, severity="page",
+        runbook="rb:win-rate-collapse",
+        summary="windowed win-rate vs scripted bots collapsed below 0.2",
+    ),
+    AlertRule(
+        # derived binary set by the OutcomeAggregator (1 while the ARMED
+        # window's p50 episode length sits below its floor — degenerate
+        # instant-reset episodes); watching the binary instead of the raw
+        # p50 keeps the unarmed zero state from false-firing
+        "episode_len_anomaly", key="outcome/episode_len_anomaly",
+        kind="threshold", op=">", value=0.0, for_s=60.0, severity="warn",
+        runbook="rb:episode-len-anomaly",
+        summary="median episode length degenerate: envs are churn-resetting",
+    ),
+    AlertRule(
+        # −1 until the first episode ever arrives (arming), then seconds
+        # since the fleet-wide episode total last advanced — fires only
+        # when a previously-live outcome stream stops
+        "outcome_stream_stale", key="outcome/stream_age_s",
+        kind="threshold", op=">", value=90.0, for_s=0.0, severity="warn",
+        runbook="rb:outcome-stale",
+        summary="no completed-episode outcome reached the learner for 90 s",
+    ),
 )
 
 

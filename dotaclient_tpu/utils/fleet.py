@@ -66,9 +66,13 @@ __all__ = [
 
 # Namespaces a peer ships in its snapshot — the compact subset that the
 # fleet table and rollups feed on (span timers never ship: their stat
-# leaves are derived, not mergeable).
+# leaves are derived, not mergeable). "outcome/" is the outcome
+# attribution plane (ISSUE 15): episode outcomes ride the SAME snapshot
+# frames — no new frame kind — and delta-merge per peer like every other
+# counter.
 SNAPSHOT_PREFIXES = (
     "actor/", "transport/", "serve/", "faults/", "trace/", "shm/",
+    "outcome/",
 )
 
 # Peer kinds, indexed by the rollout header's `length` field. The peer
@@ -97,9 +101,22 @@ AGG_KEYS = tuple(
 )
 
 # Snapshot payloads must fit the native codec's entry table
-# (serialize._MAX_TENSORS = 64): cap the shipped leaves, largest names
-# dropped last so the cut is deterministic.
+# (serialize._MAX_TENSORS = 64): cap the shipped leaves. The cut is
+# deterministic AND priority-aware — fleet-critical operational keys
+# (the rollup sources, liveness counters) are kept ahead of the outcome
+# plane's keys, and within the outcome plane the episode-length
+# histogram buckets go first: dropping a histogram tail degrades the
+# p50's resolution, dropping transport/reconnects_total would blind an
+# alert rule (pinned by test).
 _MAX_SNAPSHOT_LEAVES = 60
+
+
+def _cut_priority(name: str) -> int:
+    if name.startswith("outcome/ep_len_hist/"):
+        return 2
+    if name.startswith("outcome/"):
+        return 1
+    return 0
 
 
 # -- snapshot codec -----------------------------------------------------------
@@ -123,7 +140,8 @@ def encode_snapshot(
 
     flat: Dict[str, np.ndarray] = {}
     names = sorted(
-        n for n in (*counters, *gauges) if n.startswith(SNAPSHOT_PREFIXES)
+        (n for n in (*counters, *gauges) if n.startswith(SNAPSHOT_PREFIXES)),
+        key=lambda n: (_cut_priority(n), n),
     )[:_MAX_SNAPSHOT_LEAVES]
     keep = set(names)
     for name, v in counters.items():
@@ -335,6 +353,13 @@ class FleetAggregator:
         self._peers: Dict[str, _PeerState] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # per-tick hooks (the outcome aggregator, ISSUE 15): run after the
+        # merge/rollup but BEFORE alert evaluation, so rules watch gauges
+        # the hook just refreshed. Registered at construction time (before
+        # start()); the hook itself must be thread-safe — the outcome
+        # aggregator locks internally because in-process modes tick it
+        # from the train thread instead.
+        self._tick_hooks: List[Callable[[], None]] = []
         # eager keys (schema tier determinism — the --require-fleet
         # contract holds for ANY learner JSONL, fleet traffic or not)
         for key in ("fleet/snapshots_total", "fleet/bad_snapshots_total"):
@@ -364,6 +389,11 @@ class FleetAggregator:
         return True
 
     # -- aggregator-thread surface ----------------------------------------
+
+    def add_tick_hook(self, hook: Callable[[], None]) -> None:
+        """Register a callable run every tick between rollup and alert
+        evaluation (call BEFORE start(); see ``_tick_hooks``)."""
+        self._tick_hooks.append(hook)
 
     def start(self) -> None:
         if self._thread is not None:
@@ -400,6 +430,8 @@ class FleetAggregator:
         for recv_ts, snap in batch:
             self._merge(recv_ts, snap)
         self._rollup(now)
+        for hook in self._tick_hooks:
+            hook()
         # counters + gauges only: rules never address timer-stat leaves,
         # and the full registry snapshot() computes every timer's stats —
         # measured ~3 ms on a populated registry vs µs for this view

@@ -365,6 +365,14 @@ class JsonlSink:
 
     def __init__(self, path: str) -> None:
         self.path = path
+        # Crash-mid-write repair (ISSUE 15 bugfix sweep): a SIGKILL'd
+        # writer leaves a torn TRAILING line, which load_jsonl tolerates —
+        # but a RESTARTED process appending to the same path (chaos
+        # restarts, --restore relaunches reusing --metrics-jsonl) would
+        # concatenate its first line onto the fragment, producing a
+        # corrupt INTERIOR line no reader drops. Truncate the fragment
+        # before appending: it was already unreadable.
+        _seal_torn_tail(path)
         self._f: Optional[TextIO] = open(path, "a", buffering=1)
         self._lock = threading.Lock()
 
@@ -410,14 +418,46 @@ class JsonlSink:
                 self._f = None
 
 
+def _seal_torn_tail(path: str) -> None:
+    """Drop an unterminated trailing fragment from an existing JSONL file
+    (see :class:`JsonlSink`). Best-effort: a missing file or an
+    unwritable one degrades to the reader-side torn-line tolerance."""
+    try:
+        with open(path, "rb+") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            if size == 0:
+                return
+            f.seek(size - 1)
+            if f.read(1) == b"\n":
+                return
+            pos = size
+            while pos > 0:
+                step = min(4096, pos)
+                f.seek(pos - step)
+                data = f.read(step)
+                idx = data.rfind(b"\n")
+                if idx >= 0:
+                    f.truncate(pos - step + idx + 1)
+                    return
+                pos -= step
+            f.truncate(0)
+    except FileNotFoundError:
+        return
+    except OSError:
+        return
+
+
 def load_jsonl(path: str) -> List[str]:
     """Read a JSONL file's COMPLETE lines, tolerating the one torn
     trailing line a SIGKILL can leave (no terminating newline → the
     write was cut mid-line → the line is dropped, never parsed). The
     shared reader for ``scripts/trace_report.py`` and
     ``scripts/check_telemetry_schema.py`` — both must survive a chaos
-    harness's corpses (ISSUE 12)."""
-    with open(path, "r") as f:
+    harness's corpses (ISSUE 12). ``errors="replace"``: a write torn
+    mid-UTF-8-sequence must not raise before the torn-tail drop below
+    can even run (ISSUE 15 bugfix sweep)."""
+    with open(path, "r", errors="replace") as f:
         text = f.read()
     if not text:
         return []

@@ -667,6 +667,130 @@ class Supervisor:
             summary["fail"] = "no actor was killed — the plan never ran"
         return summary
 
+    def run_outcome(self) -> Dict:
+        """ISSUE 15 acceptance scenario — the outcome plane's
+        test-in-anger. One actor (short episodes via ``--max-dota-time``)
+        feeds the learner over the socket lane at a fast fleet cadence
+        until episode OUTCOMES have demonstrably reached the learner
+        (``outcome/episodes_total`` > 0 — counters shipped inside the
+        fleet snapshot frames, delta-merged, windowed by the
+        OutcomeAggregator riding the fleet tick). Then the actor is
+        SIGKILLed and HELD DOWN: training stalls, but the fleet thread
+        keeps ticking on wall clock, so the ``outcome_stream_stale``
+        alert must fire with its runbook anchor once the armed stream's
+        age passes the rule threshold — and RESOLVE after the restarted
+        incarnation completes fresh episodes. PASS also requires a clean
+        SIGTERM drain and a non-empty ``outcome_report`` from the
+        learner's JSONL."""
+        a = self.args
+        summary: Dict = {
+            "scenario": "outcome", "seed": a.seed, "port": self.port,
+        }
+        jsonl = os.path.join(self.workdir, "learner1.jsonl")
+        interval = a.fleet_interval
+        self.actor_extra = [
+            "--fleet-interval", str(interval), "--max-dota-time", "60",
+        ]
+        learner = self._spawn_learner(
+            1, restore=False, steps=10**6,
+            extra=["--fleet-interval", str(interval)],
+        )
+        self._tend_actors()
+
+        # 1) the outcome stream must ARM: completed episodes visible in
+        # the learner's merged totals (fleet mirrors → aggregator gauge)
+        episodes = 0.0
+        while True:
+            self._check_deadline()
+            self._tend_actors()
+            for rec in _jsonl_scalars(jsonl):
+                sc = rec.get("scalars")
+                if isinstance(sc, dict):
+                    episodes = max(
+                        episodes, sc.get("outcome/episodes_total") or 0.0
+                    )
+            if episodes >= 1:
+                break
+            if learner.poll() is not None:
+                summary["fail"] = (
+                    f"learner exited rc={learner.returncode} before any "
+                    f"episode outcome arrived"
+                )
+                return summary
+            time.sleep(0.5)
+        summary["episodes_before_kill"] = episodes
+
+        # 2) SIGKILL every actor and HOLD them down: the outcome stream
+        # stops while the learner (and its fleet/outcome ticks) live on
+        held = tuple(range(a.actors))
+        for victim in self.actors:
+            if victim is not None and victim.poll() is None:
+                victim.kill()
+                self.actor_kills += 1
+        try:
+            fired = self._wait_alert(
+                learner, jsonl, "outcome_stream_stale", "fired", skip=held,
+            )
+        except (TimeoutError, RuntimeError) as e:
+            summary["fail"] = f"outcome staleness alert never fired: {e}"
+            return summary
+        summary["stale_alert_fired"] = {
+            "runbook": fired.get("runbook"),
+            "severity": fired.get("severity"),
+        }
+
+        # 3) restart the fleet; fresh episodes must RESOLVE the alert
+        self._tend_actors()
+        try:
+            resolved = self._wait_alert(
+                learner, jsonl, "outcome_stream_stale", "resolved",
+                after_ts=fired.get("ts", 0.0),
+            )
+        except (TimeoutError, RuntimeError) as e:
+            summary["fail"] = (
+                f"outcome staleness alert did not resolve after restart: {e}"
+            )
+            return summary
+        summary["stale_alert_resolved_after_s"] = round(
+            resolved.get("ts", 0.0) - fired.get("ts", 0.0), 1
+        )
+
+        # 4) drain + the report: curves must be non-empty
+        learner.send_signal(signal.SIGTERM)
+        rc = self._wait_exit(learner, "learner (outcome scenario drain)")
+        summary["learner_exit"] = rc
+        summary.update(self._stop_actors())
+        summary["actor_restarts"] = self.actor_restarts
+        try:
+            from dotaclient_tpu.utils.telemetry import load_jsonl
+            from scripts.outcome_report import parse_stream, render
+
+            points, union, last_ts = parse_stream(load_jsonl(jsonl))
+            _text, status = render(points, union, last_ts, 40)
+            summary["outcome_status"] = status
+        except Exception as e:  # noqa: BLE001 - reported as a failure below
+            summary["outcome_status"] = None
+            summary["report_error"] = f"{type(e).__name__}: {e}"
+
+        if rc != 0:
+            summary["fail"] = "learner did not drain cleanly on SIGTERM"
+        elif summary["stale_alert_fired"]["runbook"] != "rb:outcome-stale":
+            summary["fail"] = (
+                f"staleness alert carries the wrong runbook anchor: "
+                f"{summary['stale_alert_fired']['runbook']!r}"
+            )
+        elif self.actor_kills < 1:
+            summary["fail"] = "no actor was killed — the plan never ran"
+        elif not summary.get("outcome_status") or not summary[
+            "outcome_status"
+        ].get("ok"):
+            summary["fail"] = (
+                "outcome_report found no usable outcome curves in the "
+                "learner JSONL: "
+                + summary.get("report_error", "OUTCOME_STATUS not ok")
+            )
+        return summary
+
     def cleanup(self) -> None:
         self.shutting_down = True
         # the learner too: a timed-out/failed plan must not orphan a live
@@ -695,7 +819,8 @@ def main(argv=None) -> int:
     p.add_argument("--corrupt-every", type=int, default=5,
                    help="actor 0 corrupts its corrupt-at'th frame and "
                    "every corrupt-every'th after")
-    p.add_argument("--scenario", choices=("baseline", "divergence", "alerts"),
+    p.add_argument("--scenario",
+                   choices=("baseline", "divergence", "alerts", "outcome"),
                    default="baseline",
                    help="baseline: kill/corrupt/SIGTERM/restore plan "
                    "(ISSUE 4); divergence: injected NaN gradient → "
@@ -703,7 +828,12 @@ def main(argv=None) -> int:
                    "poisoned versions never published (ISSUE 6); alerts: "
                    "actor kill → fleet_peer_stale alert fires with its "
                    "runbook anchor and resolves on restart, injected "
-                   "corrupt frames → integrity alert (ISSUE 13)")
+                   "corrupt frames → integrity alert (ISSUE 13); outcome: "
+                   "episode outcomes reach the learner via the fleet lane, "
+                   "the whole fleet is killed and held down → "
+                   "outcome_stream_stale fires with its anchor → resolves "
+                   "when the restarted fleet completes fresh episodes "
+                   "(ISSUE 15)")
     p.add_argument("--fleet-interval", type=float, default=0.5,
                    help="alerts scenario: fleet snapshot/aggregation "
                    "cadence in seconds (fast, so staleness detection and "
@@ -731,6 +861,8 @@ def main(argv=None) -> int:
             summary = sup.run_divergence()
         elif args.scenario == "alerts":
             summary = sup.run_alerts()
+        elif args.scenario == "outcome":
+            summary = sup.run_outcome()
         else:
             summary = sup.run()
     except (TimeoutError, RuntimeError) as e:

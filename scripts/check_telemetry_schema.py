@@ -255,6 +255,69 @@ FLEET_KEYS = (
     "alerts/active",                # rules firing right now
 )
 
+# Outcome attribution plane (ISSUE 15). Validated with --require-outcome
+# against ANY learner JSONL: the Learner eager-creates BOTH halves at
+# construction — the actor-side outcome counters
+# (outcome.records.ensure_actor_metrics; zeros until episodes complete)
+# and the OutcomeAggregator's curve gauges (win-rates initialized to the
+# 0.5 neutral prior, stream age to -1 until armed) — so presence is
+# deterministic in every actor mode, external fleets included.
+OUTCOME_KEYS = (
+    # aggregator curves (learner side)
+    "outcome/win_rate/vs_scripted",     # THE tier-2 honesty metric, windowed
+    "outcome/win_rate/vs_league",
+    "outcome/win_rate/overall",
+    "outcome/episode_len_p50",          # windowed median episode length
+    "outcome/episode_len_anomaly",      # 1 while armed p50 < floor
+    "outcome/stream_age_s",             # -1 unarmed; seconds since last episode
+    "outcome/episodes_total",
+    "outcome/episodes_recent",
+    "outcome/reward/xp",                # windowed per-episode term means
+    "outcome/reward/gold",
+    "outcome/reward/hp",
+    "outcome/reward/enemy_hp",
+    "outcome/reward/last_hits",
+    "outcome/reward/denies",
+    "outcome/reward/kills",
+    "outcome/reward/deaths",
+    "outcome/reward/tower_damage",
+    "outcome/reward/own_tower",
+    "outcome/reward/win",
+    # actor-side counters (episode-boundary records; fleet-shipped)
+    "outcome/episodes/vs_scripted",
+    "outcome/episodes/vs_league",
+    "outcome/episodes/vs_selfplay",
+    "outcome/wins/vs_scripted",
+    "outcome/wins/vs_league",
+    "outcome/wins/vs_selfplay",
+    "outcome/episodes_side/radiant",
+    "outcome/episodes_side/dire",
+    "outcome/ep_len_sum",
+    "outcome/ep_len_hist/00",
+    "outcome/ep_len_hist/01",
+    "outcome/ep_len_hist/02",
+    "outcome/ep_len_hist/03",
+    "outcome/ep_len_hist/04",
+    "outcome/ep_len_hist/05",
+    "outcome/ep_len_hist/06",
+    "outcome/ep_len_hist/07",
+    "outcome/ep_len_hist/08",
+    "outcome/ep_len_hist/09",
+    "outcome/ep_len_hist/10",
+    "outcome/ep_len_hist/11",
+    "outcome/reward_sum/xp",
+    "outcome/reward_sum/gold",
+    "outcome/reward_sum/hp",
+    "outcome/reward_sum/enemy_hp",
+    "outcome/reward_sum/last_hits",
+    "outcome/reward_sum/denies",
+    "outcome/reward_sum/kills",
+    "outcome/reward_sum/deaths",
+    "outcome/reward_sum/tower_damage",
+    "outcome/reward_sum/own_tower",
+    "outcome/reward_sum/win",
+)
+
 # Keys only an IN-PROCESS actor emits. A learner serving external actor
 # processes over socket/shm never runs its own collect loop, so its JSONL
 # legitimately lacks these — they are waived when the line union carries an
@@ -411,6 +474,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         "eager-creates every rollup and alert key at construction",
     )
     p.add_argument(
+        "--require-outcome", action="store_true",
+        help="also require the outcome-attribution-plane keys (ISSUE 15); "
+        "valid against ANY learner run's JSONL — the Learner eager-creates "
+        "the actor-side outcome counters AND the OutcomeAggregator's curve "
+        "gauges at construction, in every actor mode",
+    )
+    p.add_argument(
         "--require-advantage", action="store_true",
         help="also require the one-pass advantage-plane keys (ISSUE 14); "
         "valid against ANY learner run's JSONL — the Learner eager-creates "
@@ -447,6 +517,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         extra += TRACE_KEYS
     if args.require_fleet:
         extra += FLEET_KEYS
+    if args.require_outcome:
+        extra += OUTCOME_KEYS
 
     path = args.path
     if path is None:

@@ -184,6 +184,26 @@ def render(
         )
         if i == 0:
             lines.append("  ".join("-" * w for w in widths))
+    # outcome panel (ISSUE 15): the game-quality plane in two lines —
+    # is the policy winning, against whom, and is the stream even alive
+    stream_age = scalars.get("outcome/stream_age_s", -1.0)
+    lines.append(
+        "outcome: win_rate vs_scripted "
+        f"{_fmt(scalars.get('outcome/win_rate/vs_scripted'))} | vs_league "
+        f"{_fmt(scalars.get('outcome/win_rate/vs_league'))} | overall "
+        f"{_fmt(scalars.get('outcome/win_rate/overall'))}"
+    )
+    lines.append(
+        f"         episodes {int(scalars.get('outcome/episodes_total', 0))} "
+        f"({int(scalars.get('outcome/episodes_recent', 0))} in window) | "
+        f"ep_len p50 {_fmt(scalars.get('outcome/episode_len_p50'))} | "
+        f"stream "
+        + (
+            "unarmed"
+            if stream_age is None or stream_age < 0
+            else f"{stream_age:.0f}s since last episode"
+        )
+    )
     fired_total = scalars.get("alerts/fired_total", 0.0)
     lines.append(
         f"alerts: {len(actives)} active, {int(fired_total)} fired this run"
@@ -196,6 +216,18 @@ def render(
         )
     summary = {
         "step": last_step,
+        "outcome": {
+            "win_rate_vs_scripted": scalars.get(
+                "outcome/win_rate/vs_scripted"
+            ),
+            "win_rate_vs_league": scalars.get("outcome/win_rate/vs_league"),
+            "win_rate_overall": scalars.get("outcome/win_rate/overall"),
+            "episodes_total": int(
+                scalars.get("outcome/episodes_total", 0)
+            ),
+            "episode_len_p50": scalars.get("outcome/episode_len_p50"),
+            "stream_age_s": scalars.get("outcome/stream_age_s"),
+        },
         "peers": peers,
         "n_peers": int(n_live),
         "peers_stale": int(n_stale),

@@ -1,0 +1,273 @@
+"""Outcome report: win-rate curves + per-opponent table from a learner JSONL.
+
+Renders the outcome attribution plane (ISSUE 15;
+``dotaclient_tpu/outcome/``) from a learner's ``--metrics-jsonl`` stream:
+
+* **curves** — the windowed ``outcome/win_rate/{vs_scripted,vs_league,
+  overall}`` gauges across log boundaries, as unicode sparklines plus the
+  latest values (vs_scripted is the ROADMAP's tier-2 honesty metric);
+* **per-opponent table** — lifetime episodes / wins / win-rate per
+  opponent bucket, from the outcome counters (the learner's own plus
+  every ``fleet/<peer>/outcome/...`` mirror external actors shipped);
+* **game-quality row** — windowed episode-length p50, the stream age,
+  and the per-episode reward decomposition by shaping term (which term
+  collapsed when the win-rate did);
+* a machine-readable ``OUTCOME_STATUS`` JSON line (CI and the chaos
+  harness read it).
+
+Import-light (no jax) and torn-line tolerant — pointing it at a crashed
+learner's log works. Usage:
+
+    python scripts/outcome_report.py /tmp/run/learner.jsonl
+    python scripts/outcome_report.py /tmp/run/learner.jsonl --points 60
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _light_load_jsonl():
+    """The torn-line-tolerant reader WITHOUT the package import chain
+    (utils/__init__ pulls jax + orbax — a report tool must start in
+    milliseconds). Same loading discipline as fleet_status.py."""
+    mod = sys.modules.get("dotaclient_tpu.utils.telemetry")
+    if mod is None:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "_dota_telemetry_light",
+            os.path.join(_REPO, "dotaclient_tpu", "utils", "telemetry.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    return mod.load_jsonl
+
+
+load_jsonl = _light_load_jsonl()
+
+BUCKETS = ("vs_scripted", "vs_league", "vs_selfplay")
+RATE_KEYS = (
+    ("vs_scripted", "outcome/win_rate/vs_scripted"),
+    ("vs_league", "outcome/win_rate/vs_league"),
+    ("overall", "outcome/win_rate/overall"),
+)
+REWARD_TERMS = (
+    "xp", "gold", "hp", "enemy_hp", "last_hits", "denies", "kills",
+    "deaths", "tower_damage", "own_tower", "win",
+)
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def parse_stream(
+    lines: List[str],
+) -> Tuple[List[Tuple[int, Dict[str, float]]], Dict[str, float], Optional[float]]:
+    """→ ([(step, scalars per metrics line)], latest scalar union, last ts).
+
+    The latest union folds counters/gauges forward (fleet mirrors may
+    only appear on some lines); the per-line list is the curve source.
+    """
+    points: List[Tuple[int, Dict[str, float]]] = []
+    union: Dict[str, float] = {}
+    last_ts: Optional[float] = None
+    for raw in lines:
+        try:
+            obj = json.loads(raw)
+        except json.JSONDecodeError:
+            continue
+        if not isinstance(obj, dict) or "event" in obj:
+            continue
+        sc = obj.get("scalars")
+        if not isinstance(sc, dict):
+            continue
+        numeric = {
+            k: v for k, v in sc.items() if isinstance(v, (int, float))
+        }
+        union.update(numeric)
+        last_ts = obj.get("ts", last_ts)
+        step = obj.get("step")
+        if isinstance(step, int):
+            points.append((step, numeric))
+    return points, union, last_ts
+
+
+def outcome_totals(scalars: Dict[str, float]) -> Dict[str, float]:
+    """The learner's own outcome counters plus every fleet per-peer
+    mirror (same collapse as outcome.records.counter_totals, stdlib-only
+    so the report never imports jax)."""
+    totals: Dict[str, float] = {}
+    for name, v in scalars.items():
+        if name.startswith("outcome/"):
+            # gauges share the namespace; only counter-shaped families sum
+            if name.split("/", 2)[1] in (
+                "episodes", "wins", "episodes_side", "ep_len_sum",
+                "ep_len_hist", "reward_sum",
+            ):
+                totals[name] = totals.get(name, 0.0) + v
+        elif name.startswith("fleet/") and "/outcome/" in name:
+            suffix = name.split("/outcome/", 1)[1]
+            key = f"outcome/{suffix}"
+            totals[key] = totals.get(key, 0.0) + v
+    return totals
+
+
+def sparkline(values: List[float]) -> str:
+    if not values:
+        return "(no data)"
+    lo, hi = min(values), max(values)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK[3] * len(values)
+    return "".join(
+        _SPARK[min(int((v - lo) / span * (len(_SPARK) - 1)), len(_SPARK) - 1)]
+        for v in values
+    )
+
+
+def _fmt(v: Optional[float], digits: int = 3) -> str:
+    return "-" if v is None else f"{v:.{digits}f}"
+
+
+def render(
+    points: List[Tuple[int, Dict[str, float]]],
+    union: Dict[str, float],
+    last_ts: Optional[float],
+    n_points: int,
+) -> Tuple[str, dict]:
+    lines: List[str] = []
+    age = f"{time.time() - last_ts:.0f}s ago" if last_ts else "n/a"
+    last_step = points[-1][0] if points else None
+    lines.append(
+        f"== outcome report @ step {last_step if points else '?'} "
+        f"(last metrics line {age}) =="
+    )
+    # curve points: log boundaries at which the plane had ANY episodes
+    curve_pts = [
+        (step, sc) for step, sc in points
+        if sc.get("outcome/episodes_total", 0.0) > 0
+    ]
+    curves: Dict[str, List[float]] = {}
+    for label, key in RATE_KEYS:
+        curves[label] = [
+            sc[key] for _, sc in curve_pts[-n_points:] if key in sc
+        ]
+    lines.append(
+        f"win-rate curves ({len(curve_pts)} points with episode data, "
+        f"last {n_points} shown):"
+    )
+    for label, key in RATE_KEYS:
+        vals = curves[label]
+        latest = union.get(key)
+        lines.append(
+            f"  {label:12s} {sparkline(vals)}  latest {_fmt(latest)}"
+        )
+    totals = outcome_totals(union)
+    total_eps = sum(
+        totals.get(f"outcome/episodes/{b}", 0.0) for b in BUCKETS
+    )
+    lines.append("per-opponent table (lifetime, all sources):")
+    rows = [["opponent", "episodes", "wins", "win_rate"]]
+    for bucket in BUCKETS:
+        eps = totals.get(f"outcome/episodes/{bucket}", 0.0)
+        wins = totals.get(f"outcome/wins/{bucket}", 0.0)
+        rows.append(
+            [
+                bucket,
+                f"{eps:.0f}",
+                f"{wins:.0f}",
+                _fmt(wins / eps if eps else None),
+            ]
+        )
+    widths = [max(len(r[c]) for r in rows) for c in range(len(rows[0]))]
+    for i, row in enumerate(rows):
+        lines.append(
+            "  " + "  ".join(c.ljust(widths[j]) for j, c in enumerate(row))
+        )
+        if i == 0:
+            lines.append("  " + "  ".join("-" * w for w in widths))
+    p50 = union.get("outcome/episode_len_p50")
+    stream_age = union.get("outcome/stream_age_s", -1.0)
+    lines.append(
+        f"game quality: ep_len p50 {_fmt(p50, 1)} env steps | "
+        f"mean len "
+        + _fmt(
+            totals.get("outcome/ep_len_sum", 0.0) / total_eps
+            if total_eps
+            else None,
+            1,
+        )
+        + " | stream "
+        + (
+            "unarmed"
+            if stream_age is None or stream_age < 0
+            else f"{stream_age:.0f}s since last episode"
+        )
+    )
+    terms = {
+        term: union.get(f"outcome/reward/{term}") for term in REWARD_TERMS
+    }
+    shown = {
+        t: round(v, 4) for t, v in terms.items() if v is not None and v != 0
+    }
+    lines.append(
+        "reward decomposition (windowed per-episode means): "
+        + (
+            " ".join(f"{t}={v:+.3f}" for t, v in shown.items())
+            if shown
+            else "(no data)"
+        )
+    )
+    status = {
+        "ok": bool(curve_pts) and total_eps > 0,
+        "step": last_step,
+        "curve_points": len(curve_pts),
+        "episodes_total": total_eps,
+        "win_rate_vs_scripted": union.get("outcome/win_rate/vs_scripted"),
+        "win_rate_vs_league": union.get("outcome/win_rate/vs_league"),
+        "win_rate_overall": union.get("outcome/win_rate/overall"),
+        "episode_len_p50": p50,
+        "stream_age_s": stream_age,
+        "buckets": {
+            bucket: {
+                "episodes": totals.get(f"outcome/episodes/{bucket}", 0.0),
+                "wins": totals.get(f"outcome/wins/{bucket}", 0.0),
+            }
+            for bucket in BUCKETS
+        },
+        "reward_terms": shown,
+    }
+    return "\n".join(lines), status
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("path", help="a learner's --metrics-jsonl file")
+    p.add_argument(
+        "--points", type=int, default=40,
+        help="sparkline tail length (curve points shown per bucket)",
+    )
+    args = p.parse_args(argv)
+    try:
+        lines = load_jsonl(args.path)
+    except OSError as e:
+        print(f"outcome_report: cannot read {args.path}: {e}",
+              file=sys.stderr)
+        return 1
+    points, union, last_ts = parse_stream(lines)
+    text, status = render(points, union, last_ts, args.points)
+    print(text, flush=True)
+    print("OUTCOME_STATUS " + json.dumps(status, sort_keys=True), flush=True)
+    return 0 if status["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

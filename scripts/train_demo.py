@@ -82,6 +82,17 @@ def main() -> None:
                    "run resuming IN its source dir would garbage-collect "
                    "the stage-1 snapshot)")
     p.add_argument("--logdir", type=str, default=None)
+    p.add_argument("--metrics-jsonl", type=str, default=None, metavar="PATH",
+                   help="append log-boundary metrics snapshots as JSON "
+                   "lines to PATH (the headless record; enables the "
+                   "outcome win-rate curves scripts/outcome_report.py "
+                   "renders — pair with --log-every)")
+    p.add_argument("--log-every", type=int, default=None,
+                   help="log-boundary cadence in optimizer steps; default "
+                   "keeps the demo's drain-free behavior (boundaries only "
+                   "with --logdir). Mid-block boundaries reset the "
+                   "windowed stats the demo prints — accept that when you "
+                   "want dense --metrics-jsonl curves")
     p.add_argument("--actor", type=str, default="fused",
                    choices=("fused", "device"),
                    help="fused: one program per optimizer step (fastest); "
@@ -165,14 +176,20 @@ def main() -> None:
         ),
         # drain-free logging: a mid-block log boundary would reset the
         # windowed stats the demo prints (TensorBoard cadence only
-        # matters when a logdir is given)
-        log_every=10_000 if args.logdir else 1_000_000_000,
+        # matters when a logdir is given); --log-every overrides for
+        # dense --metrics-jsonl curves (the outcome plane's demo path)
+        log_every=(
+            args.log_every
+            if args.log_every is not None
+            else (10_000 if args.logdir else 1_000_000_000)
+        ),
         steps_per_dispatch=args.steps_per_dispatch,
         seed=args.seed,
     )
     learner = Learner(config, actor=args.actor, seed=args.seed,
                       logdir=args.logdir, checkpoint_dir=args.checkpoint_dir,
-                      restore=args.restore, init_from=args.init_from)
+                      restore=args.restore, init_from=args.init_from,
+                      metrics_jsonl=args.metrics_jsonl)
     policy = learner.policy
     # On --restore this snapshot is the RESTORED policy, not a step-0 init:
     # the "init" evals then baseline the transfer/resume starting point

@@ -128,3 +128,41 @@ def test_chaos_alerts_scenario(tmp_path):
     assert summary["corrupt_alert_fired"]["runbook"] == "rb:corrupt-frames"
     assert summary["alerts_fired_total"] >= 2
     assert summary["fleet_peers_seen"] >= 2
+
+
+@pytest.mark.slow
+def test_chaos_outcome_scenario(tmp_path):
+    """ISSUE 15 acceptance: episode outcomes reach the learner through
+    the fleet snapshot lane, the whole fleet killed-and-held fires
+    ``outcome_stream_stale`` with its runbook anchor (the fleet tick
+    evaluates on wall clock while training stalls), the restarted
+    fleet's fresh episodes RESOLVE it, and ``outcome_report`` finds
+    usable curves in the drained learner's JSONL."""
+    env = dict(os.environ)
+    env.pop("DOTA_FAULTS", None)
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "scripts", "chaos_run.py"),
+            "--scenario", "outcome",
+            "--workdir", str(tmp_path / "chaos"),
+            "--seed", "0",
+            "--timeout", "900",
+        ],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=960,
+    )
+    summary_lines = [
+        line for line in proc.stdout.splitlines()
+        if line.startswith("CHAOS_SUMMARY ")
+    ]
+    assert summary_lines, (
+        f"no CHAOS_SUMMARY emitted\nstdout:\n{proc.stdout[-3000:]}\n"
+        f"stderr:\n{proc.stderr[-3000:]}"
+    )
+    summary = json.loads(summary_lines[-1][len("CHAOS_SUMMARY "):])
+    assert proc.returncode == 0 and summary.get("ok"), summary
+    assert summary["learner_exit"] == 0
+    assert summary["episodes_before_kill"] >= 1
+    assert summary["stale_alert_fired"]["runbook"] == "rb:outcome-stale"
+    assert summary["stale_alert_resolved_after_s"] > 0
+    assert summary["outcome_status"]["ok"] is True
+    assert summary["outcome_status"]["episodes_total"] >= 1

@@ -223,9 +223,11 @@ class TestFusedStep:
                 ref_state, ref_actor, ref_state.params
             )
             st = jax.tree.map(np.asarray, st)
+            # tree-map: the stats carry nested leaves now (the outcome
+            # plane's reward-term dict + histogram vector, ISSUE 15)
             ref_stats_sum = (
                 st if ref_stats_sum is None
-                else {k: ref_stats_sum[k] + st[k] for k in st}
+                else jax.tree.map(lambda a, b: a + b, ref_stats_sum, st)
             )
 
         cfg_k = dataclasses.replace(cfg, steps_per_dispatch=K)
@@ -251,9 +253,13 @@ class TestFusedStep:
             np.testing.assert_allclose(
                 np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6
             )
-        for k, want in ref_stats_sum.items():
+        for (path_got, got), (_, want) in zip(
+            jax.tree_util.tree_flatten_with_path(got_stats)[0],
+            jax.tree_util.tree_flatten_with_path(ref_stats_sum)[0],
+        ):
             np.testing.assert_allclose(
-                np.asarray(got_stats[k]), want, rtol=1e-5, atol=1e-6
+                np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6,
+                err_msg=f"stats leaf {jax.tree_util.keystr(path_got)}",
             )
         assert np.isfinite(float(np.asarray(metrics["loss"])))
 
