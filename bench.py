@@ -614,6 +614,58 @@ def bench_outcome(config) -> dict:
     return out
 
 
+def bench_utilization(config) -> dict:
+    """Utilization stage (ISSUE 16): fused-path step throughput with the
+    phase accountant OFF (module knob disabled — every call site degrades
+    to one pointer test, the faults.get() discipline) vs ON (the
+    always-on default: perf_counter pairs at each phase boundary plus a
+    fold at train boundaries). The plane is designed to be always-on, so
+    its whole budget is ``utilization_overhead`` ≤ 0.02 of fused
+    throughput (the PR 13 fleet-stage pattern; best-of-2 segments per
+    variant on this noise-prone host). The on-variant also reports the
+    measured duty cycle — BENCH records start carrying where the wall
+    clock went, not just how fast it spun."""
+    import dataclasses
+
+    from dotaclient_tpu.train.learner import Learner
+    from dotaclient_tpu.utils import telemetry, utilization
+
+    base = dataclasses.replace(
+        config,
+        env=dataclasses.replace(
+            config.env, n_envs=128, opponent="scripted_easy",
+            max_dota_time=120.0,
+        ),
+        log_every=10**9,   # no boundaries: the accountant is the subject
+    )
+    steps = 100
+    out: dict = {}
+    for label in ("off", "on"):
+        utilization.enabled = label == "on"
+        learner = Learner(base, actor="fused")
+        try:
+            learner.train(10)   # compile + settle
+            best = 0.0
+            for _ in range(2):
+                t0 = time.perf_counter()
+                learner.train(steps)
+                best = max(best, steps / (time.perf_counter() - t0))
+            out[f"{label}_steps_per_sec"] = round(best, 2)
+        finally:
+            utilization.enabled = True
+            if learner._snap_engine is not None:
+                learner._snap_engine.stop()
+        if label == "on":
+            snap = telemetry.get_registry().snapshot()
+            out["duty_cycle"] = round(snap.get("util/duty_cycle", 0.0), 4)
+            out["util_armed"] = snap.get("util/armed", 0.0)
+    off, on = out["off_steps_per_sec"], out["on_steps_per_sec"]
+    out["utilization_overhead"] = (
+        round(max(0.0, 1.0 - on / off), 4) if off else 1.0
+    )
+    return out
+
+
 def bench_quantize(config) -> dict:
     """Quantize stage (ISSUE 7): the rollout experience plane, narrow vs f32.
 
@@ -1398,6 +1450,16 @@ def main() -> None:
     except Exception as e:
         outcome = {"error": f"{type(e).__name__}: {e}"}
 
+    # -- utilization stage: always-on phase accountant on vs off (ISSUE 16) --
+    try:
+        util = bench_utilization(config)
+        # acceptance: utilization_overhead ≤ 0.02 — the accountant is
+        # host interval arithmetic at existing phase boundaries, folded
+        # only at log/train boundaries
+        stages["utilization_overhead"] = util.get("utilization_overhead", 1.0)
+    except Exception as e:
+        util = {"error": f"{type(e).__name__}: {e}"}
+
     # -- quantize stage: narrow-dtype experience plane (ISSUE 7) -------------
     try:
         quantize = bench_quantize(config)
@@ -1505,6 +1567,7 @@ def main() -> None:
                 "trace": trace,
                 "fleet": fleet,
                 "outcome": outcome,
+                "utilization": util,
                 "quantize": quantize,
                 "advantage": advantage,
                 "multichip": multichip,

@@ -35,6 +35,7 @@ per-step cost is one round trip, independent of lane count.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -44,7 +45,7 @@ import numpy as np
 from dotaclient_tpu.actor.window_stats import WindowedStatsMixin
 from dotaclient_tpu.config import RunConfig
 from dotaclient_tpu.outcome import records as outcome_records
-from dotaclient_tpu.utils import telemetry
+from dotaclient_tpu.utils import telemetry, utilization
 from dotaclient_tpu.envs.env_api import LocalDotaEnv
 from dotaclient_tpu.envs import lane_sim
 from dotaclient_tpu.features import (
@@ -196,6 +197,10 @@ class ActorPool(WindowedStatsMixin):
         self._tel = telemetry.get_registry()
         # outcome counters exist (zeroed) from the first fleet snapshot on
         outcome_records.ensure_actor_metrics(self._tel)
+        # Utilization plane (ISSUE 16): always-on phase accounting — keys
+        # eager-created by the factory, None when the module knob is off
+        # (one pointer test per call site, same discipline as faults).
+        self._util = utilization.make_actor(self._tel)
 
     # -- env / lane lifecycle ---------------------------------------------
 
@@ -344,7 +349,11 @@ class ActorPool(WindowedStatsMixin):
         self._reset_mask[:] = False
 
         # Submit actions grouped per (env, team) — env steps once all agent
-        # teams have acted (env_api contract).
+        # teams have acted (env_api contract). Everything from here to the
+        # end of the observe/reward loop is env_step for the utilization
+        # plane, EXCEPT the per-lane featurize calls (accumulated apart).
+        t_env = time.perf_counter()
+        feat_s = 0.0
         by_env_team: Dict[Tuple[int, int], List[pb.Action]] = {}
         for i, lane in enumerate(self.lanes):
             idx = {h: int(actions_np[i, j]) for j, h in enumerate(D.HEADS)}
@@ -384,7 +393,9 @@ class ActorPool(WindowedStatsMixin):
             lane.dones.append(1.0 if done else 0.0)
             lane.episode_reward += r
             lane.prev_ws = ws
+            t_f = time.perf_counter()
             lane.obs = self._featurize(ws, lane.player_id)
+            feat_s += time.perf_counter() - t_f
             self.env_steps += 1
             if done:
                 # Fresh episode ⇒ fresh recurrent state: the device step
@@ -396,6 +407,11 @@ class ActorPool(WindowedStatsMixin):
             if done and lane is self._env_owner(lane.env_idx):
                 self._on_episode_end(lane.env_idx, ws)
         outcome_records.add_reward_terms(self._tel, step_terms)
+        if self._util is not None:
+            self._util.phase("featurize", feat_s)
+            self._util.phase(
+                "env_step", time.perf_counter() - t_env - feat_s
+            )
 
         if finished:
             H = self.config.model.hidden_dim
@@ -441,6 +457,7 @@ class ActorPool(WindowedStatsMixin):
 
     def _finish_chunk(self, lane_idx: int, lane: _Lane) -> None:
         """Pad, pack, and ship one rollout chunk."""
+        t_enc = time.perf_counter()
         T = self.config.ppo.rollout_len
         n = len(lane.actions)
         assert 0 < n <= T
@@ -475,6 +492,10 @@ class ActorPool(WindowedStatsMixin):
             total_reward=float(np.sum(lane.rewards)),
         )
         self._next_rollout_id += 1
+        t_ship = time.perf_counter()
+        if self._util is not None:
+            # chunk assembly above is encode; the publish leg is ship_wait
+            self._util.phase("encode", t_ship - t_enc)
         if self.rollout_sink is not None:
             # in-proc consumers get full-width protos (gRPC-parity path —
             # no wire to save bytes on)
@@ -483,6 +504,8 @@ class ActorPool(WindowedStatsMixin):
             self.transport.publish_rollout(
                 encode_rollout(arrays, **meta, **self._wire_kwargs)
             )
+        if self._util is not None:
+            self._util.phase("ship_wait", time.perf_counter() - t_ship)
         self.rollouts_shipped += 1
         self._tel.counter("actor/rollouts_shipped").inc()
         self._tel.counter("actor/frames_shipped").inc(n)
@@ -492,6 +515,9 @@ class ActorPool(WindowedStatsMixin):
         for t in range(n_steps):
             if refresh_every and t % refresh_every == 0:
                 self.refresh_weights()
+                if self._util is not None:
+                    # cadence-gated fold (one clock compare per boundary)
+                    self._util.maybe_fold()
             self.step()
         return self.stats()
 

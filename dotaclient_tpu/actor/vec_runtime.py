@@ -27,7 +27,7 @@ import numpy as np
 from dotaclient_tpu.actor.window_stats import WindowedStatsMixin
 from dotaclient_tpu.config import RunConfig
 from dotaclient_tpu.outcome import records as outcome_records
-from dotaclient_tpu.utils import faults, fleet, telemetry, tracing
+from dotaclient_tpu.utils import faults, fleet, telemetry, tracing, utilization
 from dotaclient_tpu.envs.vec_lane_sim import (
     OPPONENT_CONTROL,
     VecLaneSim,
@@ -186,6 +186,10 @@ class VecActorPool(WindowedStatsMixin):
         # one `is not None` test per emit batch (pinned by test). Per-lane
         # chunk-start stamps exist only when tracing is on.
         self._tracer = tracing.get()
+        # Utilization plane (ISSUE 16): always-on phase accounting — keys
+        # eager-created by the factory, None when the module knob is off
+        # (one pointer test per call site, same discipline as faults).
+        self._util = utilization.make_actor(self._tel)
         self._actor_tag = seed & 0xFFFF
         self._chunk_start = (
             np.full((L,), tracing.now()) if self._tracer is not None else None
@@ -299,9 +303,14 @@ class VecActorPool(WindowedStatsMixin):
                     sim_actions[k], opp_actions[k],
                     where=self._opponent.player_mask[None, :],
                 )
+        t_env = time.perf_counter()
         self.sim.step(sim_actions)
 
         r = self.rewards.compute()                                 # [L]
+        if self._util is not None:
+            # env_step = sim advance + reward compute (both host-side
+            # simulation work, indivisible from the env's point of view)
+            self._util.phase("env_step", time.perf_counter() - t_env)
         # outcome plane: every live game advanced one env step, and the
         # step's weighted per-term reward sums feed the decomposition
         self._ep_game_steps += 1
@@ -317,7 +326,10 @@ class VecActorPool(WindowedStatsMixin):
         self._cursor += 1
         self.env_steps += L
 
+        t_feat = time.perf_counter()
         obs_next = self.feat.featurize_all()
+        if self._util is not None:
+            self._util.phase("featurize", time.perf_counter() - t_feat)
         finished = (self._cursor >= T) | done_lane
         if finished.any():
             self._emit_chunks(np.nonzero(finished)[0], done_lane, obs_next, carry_np, version)
@@ -336,7 +348,10 @@ class VecActorPool(WindowedStatsMixin):
             if self._opponent is not None:
                 self._opponent.on_reset(games)
             self._reset_mask |= done_lane
+            t_feat = time.perf_counter()
             obs_next = self.feat.featurize_all()  # fresh-episode observations
+            if self._util is not None:
+                self._util.phase("featurize", time.perf_counter() - t_feat)
         self._pending_obs = obs_next
 
     def _emit_chunks(
@@ -352,6 +367,7 @@ class VecActorPool(WindowedStatsMixin):
         T = cfg.ppo.rollout_len
         out: List[DecodedRollout] = []
         blobs: List[Optional[bytes]] = []   # wire trace blob per chunk
+        t_enc = time.perf_counter()
         for l in lanes:
             n = int(self._cursor[l])
             done = bool(done_lane[l])
@@ -428,10 +444,15 @@ class VecActorPool(WindowedStatsMixin):
                     jax.tree.leaves(self._carry0), jax.tree.leaves(carry_np)
                 ):
                     buf[l] = src[l]
+        if self._util is not None:
+            # encode = chunk assembly (buffer slicing, pad, trace stamps);
+            # the publish leg below is ship_wait
+            self._util.phase("encode", time.perf_counter() - t_enc)
         self._tel.counter("actor/rollouts_shipped").inc(len(out))
         self._tel.counter("actor/frames_shipped").inc(
             float(sum(m["length"] for m, _ in out))
         )
+        t_ship = time.perf_counter()
         if self.rollout_sink is not None:
             self.rollout_sink(out)
         elif self.transport is not None:
@@ -453,6 +474,8 @@ class VecActorPool(WindowedStatsMixin):
                             arrays, **meta, **self._wire_kwargs, trace=blob
                         )
                     )
+        if self._util is not None:
+            self._util.phase("ship_wait", time.perf_counter() - t_ship)
         self.rollouts_shipped += len(out)
 
     def _record_episodes(self, games: np.ndarray) -> None:
@@ -496,6 +519,10 @@ class VecActorPool(WindowedStatsMixin):
                     # errors propagate like a failed rollout publish —
                     # the actor's reconnect machinery owns them
                     self._fleet.maybe_publish(self.transport)
+                if self._util is not None:
+                    # cadence-gated fold (one clock compare) at refresh
+                    # boundaries, same rhythm as the fleet publisher
+                    self._util.maybe_fold()
             self.step()
         return self.stats()
 

@@ -66,6 +66,7 @@ ALL_CLIS = OPERATOR_CLIS + (
     "scripts/bench_transport_producer.py",
     "scripts/check_telemetry_schema.py",
     "scripts/check_host_sync.py",
+    "scripts/bench_trajectory.py",
     "bench.py",
 )
 

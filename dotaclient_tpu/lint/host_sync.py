@@ -85,6 +85,11 @@ ALLOWED_FUNCS: Dict[str, Set[str]] = {
     # every sync-shaped cast is annotated at the line.
     "dotaclient_tpu/outcome/aggregator.py": {"__init__"},
     "dotaclient_tpu/outcome/records.py": set(),
+    # Pipeline utilization plane (ISSUE 16): pure host interval
+    # arithmetic — the accountant runs inline on the train / actor /
+    # batcher threads at existing phase boundaries, so any device touch
+    # here would tax every attributed phase; no function-level pass.
+    "dotaclient_tpu/utils/utilization.py": set(),
     # The snapshot engine IS the designated sync site (ISSUE 5): its one
     # batched fetch is annotated at the line, everything else must stay
     # host-only — no function-level pass.

@@ -96,6 +96,9 @@ OWNERSHIP: Dict[str, Dict[str, ClassMap]] = {
                 "_rollback_count": "train",
                 "_best_win": "train",
                 "_last_metrics": "train",
+                # utilization accountant (ISSUE 16): phase intervals and
+                # folds all happen on the train thread's loop
+                "_util": "train",
                 # latched stop flag: written by the signal handler, read by
                 # every loop — single bool write, stale reads are the design
                 "_stop_requested": "any",
@@ -201,6 +204,9 @@ OWNERSHIP: Dict[str, Dict[str, ClassMap]] = {
                 # latched int: written by the batcher at swap commit, read
                 # by attach frames — one-dispatch-stale reads are the design
                 "_version": "any",
+                # utilization accountant (ISSUE 16): window_wait/dispatch/
+                # reply intervals and folds all happen on the batcher
+                "_util": "batcher",
             },
         ),
     },
@@ -303,6 +309,34 @@ OWNERSHIP: Dict[str, Dict[str, ClassMap]] = {
                 "_last_episode_t": "lock:_lock",
             },
             holds={"_publish": ("_lock",), "_total_eps": ("_lock",)},
+        ),
+    },
+    "dotaclient_tpu/utils/utilization.py": {
+        # Pipeline utilization plane (ISSUE 16): an accountant is owned
+        # by exactly the thread that constructed it — train thread
+        # (LearnerUtilization), an actor pool's step loop, or serve's
+        # batcher (PoolUtilization). No locks by design: the map pins
+        # that the first cross-thread "quick fix" (folding a pool's
+        # accountant from another thread) trips this pass, not a review.
+        "PhaseAccountant": ClassMap(
+            default_thread="owner",
+            attrs={
+                "_acc": "owner",
+                "_window_start": "owner",
+            },
+        ),
+        "LearnerUtilization": ClassMap(
+            default_thread="owner",
+            attrs={
+                "_last_step": "owner",
+                "_ema_v": "owner",
+                "_baseline_v": "owner",
+                "_windows": "owner",
+            },
+        ),
+        "PoolUtilization": ClassMap(
+            default_thread="owner",
+            attrs={"_last_fold": "owner"},
         ),
     },
     "dotaclient_tpu/transport/shm_transport.py": {

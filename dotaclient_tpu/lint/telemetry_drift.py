@@ -84,6 +84,7 @@ DYNAMIC_KEY_EXPANSIONS: Dict[Tuple[str, str], Tuple[str, ...]] = {
         "env_fps/min", "env_fps/max", "env_fps/mean",
         "reconnects/min", "reconnects/max", "reconnects/mean",
         "corrupt_frames/min", "corrupt_frames/max", "corrupt_frames/mean",
+        "ship_wait/min", "ship_wait/max", "ship_wait/mean",
     ),
     # utils/fleet.py per-peer mirror keys: fleet/<peer>/<shipped metric>
     # (peer labels are runtime values — representative members here; the
@@ -133,7 +134,7 @@ KEY_PREFIXES = (
     "actor/", "advantage/", "alerts/", "buffer/", "checkpoint/",
     "compile/", "faults/", "fleet/", "health/", "league/", "learner/",
     "mem/", "mesh/", "outcome/", "serve/", "shm/", "snapshot/", "span/",
-    "trace/", "transport/",
+    "trace/", "transport/", "util/",
 )
 # single-line inline code only: multi-line matches would mispair across
 # ``` fence lines (odd backtick count flips pairing for the whole doc)

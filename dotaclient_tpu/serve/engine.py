@@ -55,7 +55,7 @@ import numpy as np
 from dotaclient_tpu.config import RunConfig
 from dotaclient_tpu.models import distributions as D
 from dotaclient_tpu.models.policy import Policy, dummy_obs_batch, mask_carry
-from dotaclient_tpu.utils import telemetry
+from dotaclient_tpu.utils import telemetry, utilization
 
 logger = logging.getLogger(__name__)
 
@@ -184,6 +184,11 @@ class ServeEngine:
         self._tel.gauge("serve/p99_latency_ms")
         self._tel.gauge("serve/weights_version").set(float(version))
         self._tel.timer("span/serve/request")
+        # Pipeline utilization plane (ISSUE 16): window_wait / dispatch /
+        # reply splits of the batcher thread's wall clock. Eager keys
+        # either way; None when the module knob is off (one pointer test
+        # per loop turn).
+        self._util = utilization.make_serve(self._tel)
         self._batcher = threading.Thread(
             target=self._batch_loop, name="serve-batcher", daemon=True
         )
@@ -312,7 +317,14 @@ class ServeEngine:
                     and not self._stopped
                     and self._peek_pending_weights() is None
                 ):
+                    # idle waiting for ANY request counts as window_wait:
+                    # the batcher is request-starved either way
+                    t_w = time.perf_counter()
                     self._cond.wait()
+                    if self._util is not None:
+                        self._util.phase(
+                            "window_wait", time.perf_counter() - t_w
+                        )
                 if self._stopped and not self._pending:
                     return
                 resets = list(self._reset_slots)
@@ -337,6 +349,8 @@ class ServeEngine:
                         "request(s) dropped; batcher continues",
                         type(e).__name__, e, len(rows),
                     )
+            if self._util is not None:
+                self._util.maybe_fold()
 
     def _peek_pending_weights(self) -> Optional[Tuple[int, Any]]:
         with self._weights_lock:
@@ -391,6 +405,10 @@ class ServeEngine:
                     self._tel.counter("serve/batch_window_hits").inc()
                     return rows
                 self._cond.wait(min(deadline - now, 0.05))
+                if self._util is not None:
+                    self._util.phase(
+                        "window_wait", time.perf_counter() - now
+                    )
 
     def _dispatch_window(self, rows: List[_Request]) -> None:
         n = len(rows)
@@ -405,6 +423,7 @@ class ServeEngine:
         self._slots_np[n:] = self._scratch_slot
         self._reset_np[n:] = 1.0            # padding gathers a zeroed carry
         rng = jax.random.fold_in(self._rng0, self._dispatch_idx)
+        t_d = time.perf_counter()
         with self._tel.span("serve/dispatch"):
             packed, logp, self._carries = self._dispatch_fn(
                 self._params, lanes, self._slots_np, self._reset_np,
@@ -417,6 +436,8 @@ class ServeEngine:
         self._dispatch_idx += 1
         version = self._version
         t_done = time.perf_counter()
+        if self._util is not None:
+            self._util.phase("dispatch", t_done - t_d)
         timer = self._tel.timer("span/serve/request")
         errors = 0
         for i, req in enumerate(rows):
@@ -428,6 +449,8 @@ class ServeEngine:
                 )
             except Exception:   # noqa: BLE001 - a dead client must not kill the batcher
                 errors += 1
+        if self._util is not None:
+            self._util.phase("reply", time.perf_counter() - t_done)
         self._tel.counter("serve/dispatches_total").inc()
         self._tel.counter("serve/replies_total").inc(n - errors)
         if errors:

@@ -79,7 +79,7 @@ from dotaclient_tpu.transport import (
     decode_rollout,
     encode_weights,
 )
-from dotaclient_tpu.utils import faults, telemetry, tracing
+from dotaclient_tpu.utils import faults, telemetry, tracing, utilization
 from dotaclient_tpu.utils.checkpoint import CheckpointManager, shape_mismatches
 from dotaclient_tpu.utils.metrics import MetricsLogger
 
@@ -650,6 +650,14 @@ class Learner:
         # checkpoint is taken, final weights publish, transports close.
         self._stop_requested = False
         self._faults = faults.get()   # None unless chaos injection is on
+        # Pipeline utilization plane (ISSUE 16, utils/utilization.py):
+        # always-on phase accountant attributing every train-thread
+        # wall-clock second to a closed phase set at the boundaries the
+        # loop already has. The factory eager-creates every util/* gauge
+        # (so `check_telemetry_schema.py --require-utilization` validates
+        # ANY learner JSONL) and returns None when the module knob is off
+        # — the faults.get() one-pointer-test discipline.
+        self._util = utilization.make_learner(self.telemetry)
         # Pipeline restore (buffer contents + device-actor state) happens
         # after those components exist; weights/opt-state restored above.
         if (
@@ -757,9 +765,18 @@ class Learner:
                 # unsplit batches are never shuffled (matches the staged
                 # path); the in-program scan ignores this placeholder
                 perms = np.broadcast_to(np.arange(B), (E, B))
+            t0 = time.perf_counter()
             with self.telemetry.span("learner/dispatch"):
                 self.state, m = self.epoch_step(
                     self.state, batch, perms.astype(np.int32)
+                )
+            if self._util is not None:
+                # the dispatch call's host time: in a throughput-bound
+                # loop it blocks on donation back-pressure — the
+                # host-observable proxy for device busy time (the
+                # accounting contract, docs/ARCHITECTURE.md)
+                self._util.phase(
+                    "dispatch_inflight", time.perf_counter() - t0
                 )
             self._dispatch_inflight = True
             self._host_step += E * M
@@ -770,8 +787,13 @@ class Learner:
             return m
         for _ in range(E):
             if M == 1:
+                t0 = time.perf_counter()
                 with self.telemetry.span("learner/dispatch"):
                     self.state, m = self.train_step(self.state, batch)
+                if self._util is not None:
+                    self._util.phase(
+                        "dispatch_inflight", time.perf_counter() - t0
+                    )
                 self._dispatch_inflight = True
                 self._host_step += 1
                 self._host_version += 1
@@ -781,11 +803,18 @@ class Learner:
             perm = self._mb_rng.permutation(B)
             self._mb_draws += 1
             for i in range(M):
+                t0 = time.perf_counter()
                 with self.telemetry.span("learner/assemble"):
                     idx = jnp.asarray(perm[i * mb:(i + 1) * mb], jnp.int32)
                     sub = self._minibatch_gather(batch, idx)
+                t1 = time.perf_counter()
                 with self.telemetry.span("learner/dispatch"):
                     self.state, m = self.train_step(self.state, sub)
+                if self._util is not None:
+                    self._util.phase("gather", t1 - t0)
+                    self._util.phase(
+                        "dispatch_inflight", time.perf_counter() - t1
+                    )
                 self._dispatch_inflight = True
                 self._host_step += 1
                 self._host_version += 1
@@ -826,11 +855,18 @@ class Learner:
         if drain_transport:
             self.ingest()
         batch = self.buffer.take(current_version=self._host_version)
+        dt = time.perf_counter() - t0
+        if self._util is not None:
+            # a productive take is batch assembly; an empty one is the
+            # buffer below min consumable — starvation, not staging
+            self._util.phase(
+                "gather" if batch is not None else "ingest_wait", dt
+            )
         if batch is not None:
             # only productive staging counts toward the overlap accounting
             # — empty polls while starved are idle waiting, not assemble
             # cost (same rule the transport/consume span applies)
-            self._prefetch_serial_s += time.perf_counter() - t0
+            self._prefetch_serial_s += dt
             self._prefetch_misses += 1
             batch = self._attach_advantages(batch)
         return batch
@@ -853,9 +889,15 @@ class Learner:
             current_version=self._host_version, hold=True
         )
         if taken is None:
+            if self._util is not None:
+                self._util.phase(
+                    "ingest_wait", time.perf_counter() - t0
+                )
             return   # nothing staged: idle waiting, not assemble cost
         self._prefetched, self._prefetch_ticket = taken
         dt = time.perf_counter() - t0
+        if self._util is not None:
+            self._util.phase("gather", dt)
         # recorded only when a batch was actually staged, like the
         # transport/consume span — empty attempts would dilute both the
         # span stats and the overlap fraction toward meaninglessness
@@ -915,6 +957,8 @@ class Learner:
         dt = time.perf_counter() - t0
         self.telemetry.gauge("advantage/pass_ms").set(dt * 1e3)
         self.telemetry.counter("advantage/passes_total").inc()
+        if self._util is not None:
+            self._util.phase("advantage_pass", dt)
         if self._adv_first:
             # the first call pays the pass's XLA compile — steady-state
             # dispatch is sub-ms, so folding seconds of compile into the
@@ -1244,6 +1288,8 @@ class Learner:
                 )
         stall = time.perf_counter() - t0
         self._stall_s += stall
+        if self._util is not None:
+            self._util.phase("publish_stall", stall)
         self.telemetry.gauge("learner/publish_stall_ms").set(stall * 1e3)
 
     def _drain_snapshots(self) -> None:
@@ -1481,6 +1527,12 @@ class Learner:
         # logged on the same line (review finding).
         if not self._fleet_started:
             self.outcome.tick()
+        # utilization fold (ISSUE 16): close the accounting window at the
+        # same host-sync boundary — host arithmetic only, arms
+        # util/duty_cycle and advances the steps/s EMA + the
+        # warmup-armed baseline the throughput sentinel compares against
+        if self._util is not None:
+            self._util.fold(self._host_step)
 
     def train(
         self,
@@ -1649,7 +1701,10 @@ class Learner:
                             self.state, cfg,
                             mark_good=self._health is not None,
                         )
-                self._stall_s += time.perf_counter() - t0
+                ckpt_dt = time.perf_counter() - t0
+                self._stall_s += ckpt_dt
+                if self._util is not None:
+                    self._util.phase("checkpoint_stall", ckpt_dt)
             if (
                 publish_midrun
                 and refresh_every
@@ -1677,9 +1732,14 @@ class Learner:
                     opp_params, opp_idx = self._league_opponent()
                     if opp_params is None:       # self-play / scripted: one
                         opp_params = self.state.params   # signature for all modes
+                    t0 = time.perf_counter()
                     self.state, da.state, m, chunk_stats = self.fused_step(
                         self.state, da.state, opp_params
                     )
+                    if self._util is not None:
+                        self._util.phase(
+                            "dispatch_inflight", time.perf_counter() - t0
+                        )
                     self._report_league(opp_idx, chunk_stats)
                     # the program ran `stride` optimizer steps over K chunks —
                     # keep the host mirrors in lockstep with the device counters
@@ -1730,6 +1790,8 @@ class Learner:
                     batch = self._next_batch()
                     if batch is None:
                         time.sleep(0.005)
+                        if self._util is not None:
+                            self._util.phase("ingest_wait", 0.005)
                         continue
                     m = self._optimize(batch)
                     if steps_done + epochs < num_steps:   # see device loop
@@ -1763,6 +1825,8 @@ class Learner:
                         batch = self._next_batch()
                         if batch is None:
                             time.sleep(0.002)
+                            if self._util is not None:
+                                self._util.phase("ingest_wait", 0.002)
                             continue
                         m = self._optimize(batch)
                         if steps_done + epochs < num_steps:   # see device loop

@@ -202,6 +202,26 @@ RULES: Tuple[AlertRule, ...] = (
         runbook="rb:outcome-stale",
         summary="no completed-episode outcome reached the learner for 90 s",
     ),
+    # -- pipeline utilization plane (ISSUE 16; utils/utilization.py) ----
+    AlertRule(
+        # util/duty_cycle initializes to the NEUTRAL 1.0 and only moves
+        # once the first fold arms the plane, so a just-started learner
+        # (or one with the accountant disabled) can never false-fire
+        "learner_duty_cycle_low", key="util/duty_cycle",
+        kind="threshold", op="<", value=0.1, for_s=120.0, severity="warn",
+        runbook="rb:duty-cycle-low",
+        summary="donated dispatch in flight under 10% of wall-clock",
+    ),
+    AlertRule(
+        # binary sentinel set by the learner fold: 1 while the fast
+        # steps/s EMA runs below REGRESSION_RATIO x the warmup-armed
+        # baseline EMA — watching the latch instead of the raw EMA keeps
+        # compile transients (baseline unarmed) from false-firing
+        "throughput_regression", key="util/throughput_regression",
+        kind="threshold", op=">", value=0.5, for_s=60.0, severity="warn",
+        runbook="rb:throughput-regression",
+        summary="learner steps/s EMA regressed below 0.7x its baseline",
+    ),
 )
 
 

@@ -72,7 +72,7 @@ __all__ = [
 # counter.
 SNAPSHOT_PREFIXES = (
     "actor/", "transport/", "serve/", "faults/", "trace/", "shm/",
-    "outcome/",
+    "outcome/", "util/",
 )
 
 # Peer kinds, indexed by the rollout header's `length` field. The peer
@@ -91,9 +91,13 @@ AGG_SOURCES: Dict[str, Tuple[str, str]] = {
     "env_fps": ("rate", "actor/env_steps"),
     "reconnects": ("counter", "transport/reconnects_total"),
     "corrupt_frames": ("counter", "transport/frames_corrupt_total"),
+    # utilization plane (ISSUE 16): the actor-side ship stall fraction —
+    # a fleet-wide climb means the learner-side ingest path (or the wire)
+    # is the bottleneck, not the envs
+    "ship_wait": ("gauge", "util/actor/ship_wait"),
 }
 AGG_STATS = ("min", "max", "mean")
-# The 12 eager-created rollup gauges — keep in sync with the
+# The 15 eager-created rollup gauges — keep in sync with the
 # ("fleet/agg/", "") expansion in lint/telemetry_drift.py and the
 # FLEET_KEYS tier in scripts/check_telemetry_schema.py.
 AGG_KEYS = tuple(

@@ -57,7 +57,25 @@ RATIO_STAGES = (
     "serve_parity",
     "prefetch_hit_rate",
     "overlap_fraction",
+    "utilization_overhead",
 )
+
+# Gate direction (ISSUE 16): stages named `*_overhead` are fractions of
+# throughput LOST — a regression is the value going UP (compared
+# absolutely: overheads sit near 0 where relative deltas explode).
+# Every other ratio stage is higher-is-better (speedups, recoveries,
+# parities, hit rates, compression) — a regression is a RELATIVE drop
+# beyond tolerance.
+def _stage_regression(
+    stage: str, prev: float, cur: float, tolerance: float
+) -> Optional[float]:
+    """→ the regression magnitude when (prev → cur) regresses ``stage``
+    beyond ``tolerance``, else None."""
+    if stage.endswith("_overhead"):
+        delta = cur - prev
+        return delta if delta > tolerance else None
+    drop = (prev - cur) / max(abs(prev), 1e-9)
+    return drop if prev > 0 and drop > tolerance else None
 
 
 def load_record(path: str) -> Optional[Dict]:
@@ -159,6 +177,45 @@ def build_trajectory(records: List[Dict]) -> Dict:
     }
 
 
+def gate_regressions(
+    records: List[Dict], tolerance: float
+) -> List[Dict]:
+    """Ratio-stage regressions between consecutive LIKE-FINGERPRINT
+    records (the CI gate, ISSUE 16). Unknown hosts never pair — a
+    regression verdict needs the host held constant even for the
+    nominally dimensionless stages (a forced-host record's overheads are
+    not a TPU record's)."""
+    regressions: List[Dict] = []
+    prev_by_fp: Dict[Tuple, Dict] = {}
+    for rec in records:
+        fp = fingerprint(rec["host"])
+        if fp == (None,):
+            continue
+        prev = prev_by_fp.get(fp)
+        if prev is not None:
+            for stage in RATIO_STAGES:
+                if stage not in prev["stages"] or stage not in rec["stages"]:
+                    continue
+                magnitude = _stage_regression(
+                    stage, prev["stages"][stage], rec["stages"][stage],
+                    tolerance,
+                )
+                if magnitude is not None:
+                    regressions.append(
+                        {
+                            "stage": stage,
+                            "from": prev["name"],
+                            "to": rec["name"],
+                            "prev": prev["stages"][stage],
+                            "value": rec["stages"][stage],
+                            "magnitude": round(magnitude, 4),
+                            "host": fingerprint_label(rec["host"]),
+                        }
+                    )
+        prev_by_fp[fp] = rec
+    return regressions
+
+
 def render(trajectory: Dict) -> str:
     lines: List[str] = ["== bench trajectory =="]
     rows = [["record", "headline", "unit", "vs_baseline", "host"]]
@@ -210,6 +267,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--dir", default=REPO,
         help="directory holding BENCH_*.json records (default: repo root)",
     )
+    p.add_argument(
+        "--gate", action="store_true",
+        help="exit nonzero when a like-fingerprint record regresses a "
+        "ratio stage beyond --tolerance (the CI gate, ISSUE 16)",
+    )
+    p.add_argument(
+        "--tolerance", type=float, default=0.05,
+        help="gate tolerance: max relative drop for higher-is-better "
+        "stages / max absolute rise for *_overhead stages (default 0.05)",
+    )
     args = p.parse_args(argv)
     paths = sorted(glob.glob(os.path.join(args.dir, "BENCH_*.json")))
     records = [r for r in (load_record(p_) for p_ in paths) if r is not None]
@@ -221,6 +288,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         "BENCH_TRAJECTORY " + json.dumps(trajectory, sort_keys=True),
         flush=True,
     )
+    if args.gate:
+        regressions = gate_regressions(records, args.tolerance)
+        for r in regressions:
+            print(
+                f"BENCH_GATE FAIL {r['stage']}: {r['prev']} → {r['value']} "
+                f"({r['from']} → {r['to']}, {r['host']}, "
+                f"magnitude {r['magnitude']} > tol {args.tolerance})",
+                flush=True,
+            )
+        if regressions:
+            return 1
+        print(
+            f"BENCH_GATE PASS ({len(records)} records, "
+            f"tolerance {args.tolerance})",
+            flush=True,
+        )
     return 0
 
 

@@ -250,6 +250,9 @@ FLEET_KEYS = (
     "fleet/agg/corrupt_frames/min",
     "fleet/agg/corrupt_frames/max",
     "fleet/agg/corrupt_frames/mean",
+    "fleet/agg/ship_wait/min",
+    "fleet/agg/ship_wait/max",
+    "fleet/agg/ship_wait/mean",
     "alerts/fired_total",           # alert rules that fired
     "alerts/resolved_total",        # alerts that cleared
     "alerts/active",                # rules firing right now
@@ -316,6 +319,27 @@ OUTCOME_KEYS = (
     "outcome/reward_sum/tower_damage",
     "outcome/reward_sum/own_tower",
     "outcome/reward_sum/win",
+)
+
+# Pipeline utilization plane (ISSUE 16). Validated with
+# --require-utilization against ANY learner JSONL: the Learner's
+# utilization.make_learner eager-creates every gauge at construction
+# even when the module knob disables the accountant, so presence is
+# deterministic — duty_cycle reads its neutral 1.0 and armed 0 until the
+# first fold.
+UTILIZATION_KEYS = (
+    "util/armed",                    # 0 until the first fold lands
+    "util/duty_cycle",               # dispatch_inflight fraction (neutral 1.0)
+    "util/steps_per_sec_ema",        # fast throughput EMA
+    "util/steps_per_sec_baseline",   # slow warmup-armed baseline EMA
+    "util/throughput_regression",    # 1 while ema < ratio * baseline
+    "util/phase/dispatch_inflight",  # donated step in flight (duty cycle)
+    "util/phase/ingest_wait",        # buffer below min consumable
+    "util/phase/gather",             # batch staging/assembly
+    "util/phase/advantage_pass",     # consume-time value+GAE dispatch
+    "util/phase/publish_stall",      # weight-publish wait
+    "util/phase/checkpoint_stall",   # checkpoint wait
+    "util/phase/host_other",         # residual unattributed host time
 )
 
 # Keys only an IN-PROCESS actor emits. A learner serving external actor
@@ -487,6 +511,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         "them whether the pass is live or the run recomputes in-step",
     )
     p.add_argument(
+        "--require-utilization", action="store_true",
+        help="also require the pipeline-utilization-plane keys (ISSUE 16); "
+        "valid against ANY learner run's JSONL — the Learner eager-creates "
+        "every util/* gauge at construction, accountant enabled or not",
+    )
+    p.add_argument(
         "--require-multichip", action="store_true",
         help="also require the multi-chip learner keys (ISSUE 10); valid "
         "against ANY learner run's JSONL at any device count — the "
@@ -519,6 +549,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         extra += FLEET_KEYS
     if args.require_outcome:
         extra += OUTCOME_KEYS
+    if args.require_utilization:
+        extra += UTILIZATION_KEYS
 
     path = args.path
     if path is None:

@@ -7,6 +7,8 @@ FleetAggregator's merged ``fleet/`` keys ride, plus the structured
 * the **fleet table**: one row per reporting peer (actors ``aN``, serve
   ``sN``) with env steps/sec, weight-refresh staleness, reconnects, and
   corrupt frames, plus the min/max/mean rollups;
+* the **utilization panel** (ISSUE 16): the learner's duty cycle, its
+  top stall phases, and the throughput sentinel's state;
 * the **alert board**: every alert currently active (fired, not yet
   resolved), with severity and its OPERATIONS.md runbook anchor;
 * a machine-readable ``FLEET_STATUS`` JSON line (the chaos harness and
@@ -91,8 +93,14 @@ def parse_stream(
             scalars.update(
                 {k: v for k, v in sc.items() if isinstance(v, (int, float))}
             )
-            last_ts = obj.get("ts", last_ts)
-            last_step = obj.get("step", last_step)
+            ts = obj.get("ts")
+            if isinstance(ts, (int, float)):
+                # non-numeric ts (torn/corrupt envelope) must not poison
+                # render()'s age arithmetic — keep the last good stamp
+                last_ts = ts
+            step = obj.get("step")
+            if isinstance(step, int):
+                last_step = step
     return scalars, events, last_ts, last_step
 
 
@@ -204,6 +212,33 @@ def render(
             else f"{stream_age:.0f}s since last episode"
         )
     )
+    # utilization panel (ISSUE 16): where the learner's wall-clock goes —
+    # duty cycle first, then the stall phases worth looking at, then the
+    # throughput sentinel state
+    util_armed = scalars.get("util/armed", 0.0)
+    if util_armed:
+        top_phases = sorted(
+            (
+                (k.rsplit("/", 1)[1], v)
+                for k, v in scalars.items()
+                if k.startswith("util/phase/")
+            ),
+            key=lambda kv: -kv[1],
+        )[:3]
+        regression = scalars.get("util/throughput_regression", 0.0)
+        lines.append(
+            f"util: duty_cycle {_fmt(scalars.get('util/duty_cycle'))} | "
+            + " | ".join(f"{name} {frac:.2f}" for name, frac in top_phases)
+        )
+        lines.append(
+            "      steps/s ema "
+            f"{_fmt(scalars.get('util/steps_per_sec_ema'))} (baseline "
+            f"{_fmt(scalars.get('util/steps_per_sec_baseline'))}) | "
+            "sentinel "
+            + ("REGRESSED" if regression else "ok")
+        )
+    else:
+        lines.append("util: unarmed (no fold yet)")
     fired_total = scalars.get("alerts/fired_total", 0.0)
     lines.append(
         f"alerts: {len(actives)} active, {int(fired_total)} fired this run"
@@ -227,6 +262,14 @@ def render(
             ),
             "episode_len_p50": scalars.get("outcome/episode_len_p50"),
             "stream_age_s": scalars.get("outcome/stream_age_s"),
+        },
+        "util": {
+            "armed": bool(util_armed),
+            "duty_cycle": scalars.get("util/duty_cycle"),
+            "steps_per_sec_ema": scalars.get("util/steps_per_sec_ema"),
+            "throughput_regression": bool(
+                scalars.get("util/throughput_regression", 0.0)
+            ),
         },
         "peers": peers,
         "n_peers": int(n_live),

@@ -93,7 +93,11 @@ def parse_stream(
             k: v for k, v in sc.items() if isinstance(v, (int, float))
         }
         union.update(numeric)
-        last_ts = obj.get("ts", last_ts)
+        ts = obj.get("ts")
+        if isinstance(ts, (int, float)):
+            # a non-numeric ts (torn/corrupt envelope) must not poison
+            # render()'s age arithmetic — keep the last good stamp
+            last_ts = ts
         step = obj.get("step")
         if isinstance(step, int):
             points.append((step, numeric))

@@ -118,6 +118,25 @@ def load_events(paths: List[str]) -> Tuple[List[dict], int]:
     return events, skipped
 
 
+def _clean_hops(raw: object) -> Dict[str, float]:
+    """Well-formed hops only: ``[name, ts]`` pairs with a string name and
+    a numeric timestamp. A torn/fuzzed event (1-element hop, null ts)
+    must degrade to "hop absent", never crash the merge or the downstream
+    delta arithmetic (pinned by canned-log test)."""
+    hops: Dict[str, float] = {}
+    if not isinstance(raw, (list, tuple)):
+        return hops
+    for entry in raw:
+        if (
+            isinstance(entry, (list, tuple))
+            and len(entry) == 2
+            and isinstance(entry[0], str)
+            and isinstance(entry[1], (int, float))
+        ):
+            hops.setdefault(entry[0], float(entry[1]))
+    return hops
+
+
 def merge_chunks(events: List[dict]) -> Dict[str, dict]:
     """tid → merged ROLLOUT chunk record. Multiple processes emit the
     same tid (actor partial at ship, learner complete at dispatch); hops
@@ -133,8 +152,8 @@ def merge_chunks(events: List[dict]) -> Dict[str, dict]:
         tid = ev.get("tid")
         if not tid:
             continue
-        hop_names = {h[0] for h in ev.get("hops", ()) if h}
-        if hop_names & {"reply", "done"}:
+        hops = _clean_hops(ev.get("hops"))
+        if hops.keys() & {"reply", "done"}:
             continue  # serve record: reported by serve_rtts, not here
         rec = chunks.setdefault(
             tid,
@@ -146,7 +165,7 @@ def merge_chunks(events: List[dict]) -> Dict[str, dict]:
                 "hops": {},
             },
         )
-        for name, ts in ev.get("hops", ()):
+        for name, ts in hops.items():
             rec["hops"].setdefault(name, ts)
     return chunks
 
@@ -215,16 +234,22 @@ def staleness_attribution(
     publishes: Dict[int, float] = {}
     applies: Dict[Tuple[int, int], float] = {}
     for ev in events:
-        if ev.get("event") == "publish" and "version" in ev:
-            publishes.setdefault(int(ev["version"]), ev.get("ts", 0.0))
+        # a torn/fuzzed event may carry version: null or a non-numeric
+        # ts — treat it as "event absent", never crash the attribution
+        # (pinned by canned-log test)
+        version = ev.get("version")
+        if not isinstance(version, (int, float)):
             continue
-        if ev.get("event") == "apply" and "version" in ev:
-            applies.setdefault(
-                (ev.get("pid"), int(ev["version"])), ev.get("ts", 0.0)
-            )
-            if ev.get("publish_ts") is not None:
+        ts = ev.get("ts")
+        ts = float(ts) if isinstance(ts, (int, float)) else 0.0
+        if ev.get("event") == "publish":
+            publishes.setdefault(int(version), ts)
+            continue
+        if ev.get("event") == "apply":
+            applies.setdefault((ev.get("pid"), int(version)), ts)
+            if isinstance(ev.get("publish_ts"), (int, float)):
                 publishes.setdefault(
-                    int(ev["version"]), float(ev["publish_ts"])
+                    int(version), float(ev["publish_ts"])
                 )
     fanout: List[float] = []
     hold: List[float] = []
@@ -235,7 +260,11 @@ def staleness_attribution(
         wv = rec.get("wv")
         encode = hops.get("encode")
         dispatch = hops.get("dispatch")
-        if wv is None or encode is None or dispatch is None:
+        if (
+            not isinstance(wv, (int, float))
+            or encode is None
+            or dispatch is None
+        ):
             continue
         pub = publishes.get(int(wv))
         app = applies.get((rec.get("origin_pid"), int(wv)))
@@ -272,7 +301,7 @@ def serve_rtts(events: List[dict]) -> dict:
     for ev in events:
         if ev.get("event") != "chunk":
             continue
-        hops = dict(ev.get("hops", ()))
+        hops = _clean_hops(ev.get("hops"))
         if "done" in hops and "encode" in hops:
             rtts.append(hops["done"] - hops["encode"])
             if "reply" in hops and "recv" in hops:

@@ -1,0 +1,223 @@
+"""Utilization report: per-process phase attribution from a learner JSONL.
+
+Renders the pipeline utilization plane (ISSUE 16;
+``dotaclient_tpu/utils/utilization.py``) from a learner's
+``--metrics-jsonl`` stream:
+
+* **learner row** — duty cycle (donated dispatch in flight) plus the
+  closed learner phase set (``util/phase/*``) as an attribution bar;
+* **peer rows** — every fleet peer that shipped ``util/actor/*`` or
+  ``util/serve/*`` fractions on its snapshot frames
+  (``fleet/<peer>/util/...`` mirrors), one row per process;
+* **sentinel row** — the steps/s fast EMA vs the warmup-armed baseline
+  and whether the ``throughput_regression`` latch is up;
+* a machine-readable ``UTILIZATION_STATUS`` JSON line (CI reads it).
+
+Import-light (no jax) and torn-line tolerant — pointing it at a crashed
+learner's log works. Usage:
+
+    python scripts/utilization_report.py /tmp/run/learner.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _light_load_jsonl():
+    """The torn-line-tolerant reader WITHOUT the package import chain
+    (utils/__init__ pulls jax + orbax — a report tool must start in
+    milliseconds). Same loading discipline as fleet_status.py."""
+    mod = sys.modules.get("dotaclient_tpu.utils.telemetry")
+    if mod is None:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "_dota_telemetry_light",
+            os.path.join(_REPO, "dotaclient_tpu", "utils", "telemetry.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    return mod.load_jsonl
+
+
+load_jsonl = _light_load_jsonl()
+
+# keep in sync with utilization.LEARNER_PHASES / ACTOR_PHASES /
+# SERVE_PHASES (duplicated here so the report never imports the package)
+LEARNER_PHASES = (
+    "dispatch_inflight", "ingest_wait", "gather", "advantage_pass",
+    "publish_stall", "checkpoint_stall", "host_other",
+)
+ACTOR_PHASES = ("env_step", "featurize", "encode", "ship_wait", "other")
+SERVE_PHASES = ("window_wait", "dispatch", "reply", "other")
+
+
+def parse_stream(
+    lines: List[str],
+) -> Tuple[Dict[str, float], Optional[float], Optional[int]]:
+    """→ (latest scalar union, last ts, last step)."""
+    union: Dict[str, float] = {}
+    last_ts: Optional[float] = None
+    last_step: Optional[int] = None
+    for raw in lines:
+        try:
+            obj = json.loads(raw)
+        except json.JSONDecodeError:
+            continue
+        if not isinstance(obj, dict) or "event" in obj:
+            continue
+        sc = obj.get("scalars")
+        if not isinstance(sc, dict):
+            continue
+        union.update(
+            {k: v for k, v in sc.items() if isinstance(v, (int, float))}
+        )
+        ts = obj.get("ts")
+        if isinstance(ts, (int, float)):
+            last_ts = ts
+        step = obj.get("step")
+        if isinstance(step, int):
+            last_step = step
+    return union, last_ts, last_step
+
+
+def _phase_row(
+    scalars: Dict[str, float], prefix: str, phases: Tuple[str, ...]
+) -> Optional[Dict[str, float]]:
+    """Phase fractions under ``prefix`` — None until any are nonzero
+    (eager-created zeros mean "not yet folded", not "all residual")."""
+    row = {p: scalars.get(f"{prefix}{p}", 0.0) for p in phases}
+    return row if any(v > 0.0 for v in row.values()) else None
+
+
+def peer_rows(scalars: Dict[str, float]) -> Dict[str, Dict[str, float]]:
+    """fleet/<peer>/util/{actor,serve}/<phase> mirrors → one row per
+    peer that shipped any utilization fractions."""
+    rows: Dict[str, Dict[str, float]] = {}
+    for peer_kind, phases in (("actor", ACTOR_PHASES), ("serve", SERVE_PHASES)):
+        peers = set()
+        marker = f"/util/{peer_kind}/"
+        for key in scalars:
+            if key.startswith("fleet/") and marker in key:
+                peers.add(key.split("/", 2)[1])
+        for peer in peers:
+            row = _phase_row(
+                scalars, f"fleet/{peer}/util/{peer_kind}/", phases
+            )
+            if row is not None:
+                rows[peer] = row
+    return rows
+
+
+def _fmt(v: Optional[float], digits: int = 3) -> str:
+    return "-" if v is None else f"{v:.{digits}f}"
+
+
+def render(
+    scalars: Dict[str, float],
+    last_ts: Optional[float],
+    last_step: Optional[int],
+) -> Tuple[str, dict]:
+    lines: List[str] = []
+    age = f"{time.time() - last_ts:.0f}s ago" if last_ts else "n/a"
+    lines.append(
+        f"== utilization report @ step "
+        f"{last_step if last_step is not None else '?'} "
+        f"(last metrics line {age}) =="
+    )
+    armed = scalars.get("util/armed", 0.0) > 0.0
+    duty = scalars.get("util/duty_cycle")
+    learner_row = _phase_row(scalars, "util/phase/", LEARNER_PHASES)
+    peers = peer_rows(scalars)
+
+    # attribution table: one row per process, one column per phase (the
+    # union of the three taxonomies; absent phases render "-")
+    all_phases: List[str] = list(LEARNER_PHASES)
+    for p in ACTOR_PHASES + SERVE_PHASES:
+        if p not in all_phases:
+            all_phases.append(p)
+    table_rows: List[Tuple[str, Dict[str, float]]] = []
+    if learner_row is not None:
+        table_rows.append(("learner", learner_row))
+    for peer in sorted(peers):
+        table_rows.append((peer, peers[peer]))
+    if table_rows:
+        used = [
+            p for p in all_phases
+            if any(p in row for _, row in table_rows)
+        ]
+        header = ["process"] + used
+        rows = [header]
+        for name, row in table_rows:
+            rows.append(
+                [name] + [
+                    f"{row[p]:.3f}" if p in row else "-" for p in used
+                ]
+            )
+        widths = [max(len(r[c]) for r in rows) for c in range(len(header))]
+        for i, row in enumerate(rows):
+            lines.append(
+                "  ".join(c.ljust(widths[j]) for j, c in enumerate(row))
+            )
+            if i == 0:
+                lines.append("  ".join("-" * w for w in widths))
+    else:
+        lines.append(
+            "no phase attribution yet (plane "
+            + ("armed but not folded" if armed else "unarmed")
+            + ")"
+        )
+    ema = scalars.get("util/steps_per_sec_ema")
+    baseline = scalars.get("util/steps_per_sec_baseline")
+    regression = scalars.get("util/throughput_regression", 0.0) > 0.0
+    lines.append(
+        f"duty cycle {_fmt(duty)} | steps/s ema {_fmt(ema)} "
+        f"(baseline {_fmt(baseline)}) | sentinel "
+        + ("REGRESSED" if regression else "ok")
+    )
+    status = {
+        "ok": armed and learner_row is not None and not regression,
+        "armed": armed,
+        "step": last_step,
+        "duty_cycle": duty,
+        "steps_per_sec_ema": ema,
+        "steps_per_sec_baseline": baseline,
+        "throughput_regression": regression,
+        "phases": learner_row or {},
+        "peers": peers,
+    }
+    return "\n".join(lines), status
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("path", help="a learner's --metrics-jsonl file")
+    args = p.parse_args(argv)
+    try:
+        lines = load_jsonl(args.path)
+    except OSError as e:
+        print(f"utilization_report: cannot read {args.path}: {e}",
+              file=sys.stderr)
+        return 1
+    scalars, last_ts, last_step = parse_stream(lines)
+    text, status = render(scalars, last_ts, last_step)
+    print(text, flush=True)
+    print(
+        "UTILIZATION_STATUS " + json.dumps(status, sort_keys=True),
+        flush=True,
+    )
+    return 0 if status["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
