@@ -1120,6 +1120,76 @@ def bench_multichip(config) -> dict:
     }
 
 
+def bench_fused_multichip(config) -> dict:
+    """Fused multichip stage (PR 18): the ONE-dispatch lane-sharded fused
+    program (rollout + update, ``train/fused.py``), 1 vs N forced host
+    devices.
+
+    Delegates to ``scripts/run_multichip.py --fused-parity N`` — the
+    shared verdict tool (ci_gate.sh runs the same thing at 1-vs-2): it
+    spawns one fused probe per device count in a fresh subprocess (env
+    pinned before backend init, the PR 10 pattern) and gates the
+    three-tier digest — ``rollout_l1`` bitwise (the lane-sharded rollout
+    has no collective, so its chunk must be byte-identical), per-dispatch
+    losses at Adam-amplified reassociation tolerance, the float64
+    param-L1 checksum at 1e-5 relative — plus the compiled
+    ``input_shardings`` proof that the actor state's lane arrays are
+    data-sharded, not replicated.
+
+    Headlines:
+
+    * ``fused_multichip_parity`` — 1.0 iff all digest tiers AND the
+      lane-sharding proof pass. Gated.
+    * ``fused_scaling_efficiency`` — (fps_N / fps_1) / N. REPORTED, not
+      gated, on CPU (forced host devices share cores — see
+      bench_multichip).
+    """
+    import subprocess
+    import sys
+
+    n_devices = 8
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "scripts", "run_multichip.py"),
+            "--fused-parity", str(n_devices), "--steps", "4",
+        ],
+        cwd=REPO, env={**os.environ}, capture_output=True, text=True,
+        timeout=1800,
+    )
+    line = next(
+        (
+            ln for ln in reversed(proc.stdout.splitlines())
+            if ln.strip().startswith("{")
+        ),
+        None,
+    )
+    if line is None:
+        raise RuntimeError(
+            f"fused-parity verdict produced no JSON (rc {proc.returncode}):"
+            f" {proc.stdout[-400:]} {proc.stderr[-400:]}"
+        )
+    verdict = json.loads(line)
+    if verdict.get("skipped"):
+        raise RuntimeError(
+            f"fused-parity skipped: {verdict.get('reason', 'unknown')}"
+        )
+    probes = verdict.get("probes", {})
+    fps_1 = probes.get("1", {}).get("optimizer_frames_per_sec", 0.0)
+    fps_n = probes.get(str(n_devices), {}).get(
+        "optimizer_frames_per_sec", 0.0
+    )
+    return {
+        "n_devices": n_devices,
+        "optimizer_fps_1dev": fps_1,
+        f"optimizer_fps_{n_devices}dev": fps_n,
+        "fused_multichip_parity": 1.0 if verdict.get("ok") else 0.0,
+        "fused_scaling_efficiency": verdict.get("scaling_efficiency", 0.0),
+        "lane_sharded": bool(verdict.get("lane_sharded")),
+        "parity": verdict.get("parity"),
+    }
+
+
 def bench_serve(config) -> dict:
     """Serve stage (ISSUE 11): the continuous-batching policy server's
     headline curve — actions/sec and p99 request latency vs batch window —
@@ -1494,6 +1564,22 @@ def main() -> None:
     except Exception as e:
         multichip = {"error": f"{type(e).__name__}: {e}"}
 
+    # -- fused multichip stage (PR 18): lane-sharded one-dispatch program ----
+    try:
+        fused_multichip = bench_fused_multichip(config)
+        # acceptance: fused_multichip_parity == 1.0 (bitwise rollout
+        # digest + Adam-tolerance losses + param checksum + compiled
+        # lane-sharding proof); fused_scaling_efficiency REPORTED only on
+        # CPU (forced host devices share cores)
+        stages["fused_multichip_parity"] = fused_multichip.get(
+            "fused_multichip_parity", 0.0
+        )
+        stages["fused_scaling_efficiency"] = fused_multichip.get(
+            "fused_scaling_efficiency", 0.0
+        )
+    except Exception as e:
+        fused_multichip = {"error": f"{type(e).__name__}: {e}"}
+
     # -- serve stage: continuous-batching policy server (ISSUE 11) -----------
     try:
         serve = bench_serve(config)
@@ -1571,6 +1657,7 @@ def main() -> None:
                 "quantize": quantize,
                 "advantage": advantage,
                 "multichip": multichip,
+                "fused_multichip": fused_multichip,
                 "serve": serve,
                 "telemetry_jsonl": telemetry_path,
             }

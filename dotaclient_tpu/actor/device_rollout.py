@@ -49,14 +49,96 @@ class DeviceActorState(NamedTuple):
     sim: sim_mod.SimState
     carry: Tuple[jnp.ndarray, jnp.ndarray]       # learner lanes' LSTM state
     opp_carry: Tuple[jnp.ndarray, jnp.ndarray]   # opponent lanes' (or dummy)
+    # f32/u32 [N, 2] per-GAME PRNG keys: each game's lanes sample from that
+    # game's key, so action sampling is shard-local when the game axis is
+    # partitioned over the mesh (and bitwise independent of the shard count)
     key: jnp.ndarray
     ep_return: jnp.ndarray                       # f32 [L] running episode return
     # i32 [N] env steps into each game's CURRENT episode (outcome plane:
     # episode length at the done site, reset in-scan)
     ep_steps: jnp.ndarray
-    # cumulative episode stats, accumulated IN the rollout program so a
-    # drain fetches a few scalars however many chunks were collected
+    # cumulative episode stats, accumulated IN the rollout program as
+    # per-game/per-lane PARTIALS (shard-local, no in-program collective);
+    # a drain fetches them and reduce_device_stats sums the game axis
     stats: Dict[str, jnp.ndarray]
+
+
+def actor_state_sharding(state: DeviceActorState, mesh, mesh_config):
+    """The lane sharding of one ``DeviceActorState``: a matching tree of
+    ``NamedSharding``s, game/lane leading axes partitioned over the
+    (dcn×)data mesh axes, true scalars replicated.
+
+    One rule (``parallel.mesh.row_sharding``): a leaf whose leading axis
+    divides the batch shard count is data-sharded, anything else is
+    replicated. Lane order is game-major (lane = game·A + player), so a
+    game count divisible by the shard count keeps every derived lane
+    tensor — featurized obs, carries, rewards — local to its games' shard;
+    ``make_fused_step`` enforces that divisibility up front. The sim's
+    batch-wide PRNG key (creep-wave jitter only) is pinned replicated
+    explicitly: its [2] shape must never be mistaken for a 2-row batch.
+    """
+    from dotaclient_tpu.parallel.mesh import replicated, row_sharding
+
+    repl = replicated(mesh)
+
+    def rows(leaf):
+        n = leaf.shape[0] if getattr(leaf, "ndim", 0) else 0
+        return row_sharding(mesh, mesh_config, n)
+
+    sim_sh = state.sim._replace(
+        **{
+            f: (repl if f == "key" else rows(getattr(state.sim, f)))
+            for f in sim_mod.SimState._fields
+        }
+    )
+    return DeviceActorState(
+        sim=sim_sh,
+        carry=jax.tree.map(rows, state.carry),
+        opp_carry=jax.tree.map(rows, state.opp_carry),
+        key=rows(state.key),
+        ep_return=rows(state.ep_return),
+        ep_steps=rows(state.ep_steps),
+        stats=jax.tree.map(rows, state.stats),
+    )
+
+
+def reduce_device_stats(stats: Dict[str, Any]) -> Dict[str, Any]:
+    """Collapse fetched per-game/per-lane stat partials to the scalar dict
+    the host surfaces expect (counters → scalars, the per-game episode-
+    length histogram ``[N, B]`` → ``[B]``). Pure host numpy — the drain
+    reduces AFTER its one batched fetch; scalar-shaped legacy accumulators
+    pass through unchanged."""
+    out: Dict[str, Any] = {}
+    for k, v in stats.items():
+        if isinstance(v, dict):
+            out[k] = reduce_device_stats(v)
+        elif k == "out_ep_len_hist":
+            a = np.asarray(v)
+            out[k] = a.sum(axis=0) if a.ndim == 2 else a
+        else:
+            out[k] = np.asarray(v).sum()
+    return out
+
+
+def sample_per_game(
+    keys: jnp.ndarray, logits, obs, n_games: int
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+    """``D.sample`` vmapped over the game axis: lanes are game-major, so
+    each game's block of lanes draws from that game's own key ``[N, 2]``.
+    Random-bit generation therefore partitions WITH the games when they
+    shard over a mesh — a single batch-wide key would make every device
+    generate the full lane set's bits — and the sampled actions are
+    bitwise independent of the shard count."""
+    def split_g(t):
+        return t.reshape((n_games, t.shape[0] // n_games) + t.shape[1:])
+
+    def merge_g(t):
+        return t.reshape((t.shape[0] * t.shape[1],) + t.shape[2:])
+
+    acts, logp = jax.vmap(D.sample)(
+        keys, jax.tree.map(split_g, logits), jax.tree.map(split_g, obs)
+    )
+    return jax.tree.map(merge_g, acts), merge_g(logp)
 
 
 def build_spec(config: RunConfig) -> VecSimSpec:
@@ -100,6 +182,8 @@ class DeviceActor:
         policy: Policy,
         seed: int = 0,
         registry: Optional[telemetry.Registry] = None,
+        mesh=None,
+        mesh_config=None,
     ) -> None:
         self.config = config
         self.policy = policy
@@ -143,11 +227,41 @@ class DeviceActor:
             sim=sim0,
             carry=policy.initial_state(self.n_lanes),
             opp_carry=policy.initial_state(opp_lanes),
-            key=key,
+            # one independent key per game: sampling stays shard-local (and
+            # bitwise shard-count-invariant) when games partition over a mesh
+            key=jax.random.split(key, N),
             ep_return=jnp.zeros((self.n_lanes,), jnp.float32),
             ep_steps=jnp.zeros((N,), jnp.int32),
             stats=self._zero_stats(),
         )
+        # Pod-scale fused Anakin (ISSUE 18): when a mesh is given, the actor
+        # state is COMMITTED lane-sharded at construction — games (and the
+        # game-major lanes they own) partition over the (dcn×)data axes, so
+        # the fused program's pinned in_shardings are satisfied by layout
+        # instead of a first-call reshard, and the buffered device mode's
+        # inferred-sharding collect computes on local lanes too.
+        self.mesh = mesh
+        self.mesh_config = mesh_config if mesh_config is not None else (
+            config.mesh if mesh is not None else None
+        )
+        if mesh is not None:
+            from dotaclient_tpu.parallel.mesh import batch_shard_count
+
+            # EFFECTIVE lane shard count: the games (and their game-major
+            # lanes) must split evenly over the batch shards, else
+            # row_sharding has degraded the layout to replicated and the
+            # honest answer is 1 — mirrors train/fused.py's eff_shards.
+            n = batch_shard_count(mesh, self.mesh_config)
+            self.lane_shards = (
+                n if self.n_lanes % n == 0 and N % n == 0 else 1
+            )
+            self.state = jax.device_put(
+                self.state,
+                actor_state_sharding(self.state, mesh, self.mesh_config),
+            )
+        else:
+            self.lane_shards = 1
+        self.lanes_per_shard = self.n_lanes // self.lane_shards
         # Outcome plane (ISSUE 15): static per-game opponent-bucket masks
         # for the in-graph done-masked reductions, and the owner side the
         # drained stats attribute to.
@@ -221,23 +335,36 @@ class DeviceActor:
         opp_lanes = max(
             len(self.opponent_players) * self.spec.n_games, 1
         )
-        self.state = self.state._replace(
+        state = self.state._replace(
             carry=self.policy.initial_state(self.n_lanes),
             opp_carry=self.policy.initial_state(opp_lanes),
         )
+        if self.mesh is not None:
+            # fresh zero carries are host constants — re-commit them to the
+            # lane sharding so the next dispatch starts layout-clean
+            state = jax.device_put(
+                state, actor_state_sharding(state, self.mesh, self.mesh_config)
+            )
+        self.state = state
 
-    @staticmethod
-    def _zero_stats() -> Dict[str, jnp.ndarray]:
-        z = jnp.zeros((), jnp.float32)
+    def _zero_stats(self) -> Dict[str, jnp.ndarray]:
+        """Per-game/per-lane PARTIAL accumulators (ISSUE 18): counters keep
+        the game axis, per-term reward sums the lane axis, so accumulation
+        inside the sharded rollout program never crosses a shard boundary;
+        shapes are mesh-size independent (checkpoints restore 8→1 and 1→8
+        unchanged). ``reduce_device_stats`` folds them at drain time."""
+        N, L = self.spec.n_games, self.n_lanes
+        zg = jnp.zeros((N,), jnp.float32)
+        zl = jnp.zeros((L,), jnp.float32)
         out = {
-            "episodes": z, "wins": z, "reward_sum": z, "ep_return_sum": z,
-            "league_episodes": z, "league_wins": z,
+            "episodes": zg, "wins": zg, "reward_sum": zl, "ep_return_sum": zg,
+            "league_episodes": zg, "league_wins": zg,
         }
         # outcome plane (ISSUE 15): per-bucket episode outcomes, episode
         # lengths (+ pow2 histogram), and the per-term reward sums
-        out.update(outcome_ingraph.zero_outcome_stats())
+        out.update(outcome_ingraph.zero_outcome_stats(N))
         out["out_reward_terms"] = {
-            term: z for term in outcome_records.REWARD_TERMS
+            term: zl for term in outcome_records.REWARD_TERMS
         }
         return out
 
@@ -266,13 +393,17 @@ class DeviceActor:
 
         def body(c, _):
             sim, lstm, opp_lstm, key, ep_ret, ep_steps = c
-            key, k_act, k_opp = jax.random.split(key, 3)
+            # per-GAME key triple [N, 3, 2]: carry / learner lanes / opp
+            # lanes — each game's stream is independent, so the whole split
+            # is shard-local under the lane sharding
+            ks = jax.vmap(lambda k: jax.random.split(k, 3))(key)
+            key2, k_act, k_opp = ks[:, 0], ks[:, 1], ks[:, 2]
 
             obs = feat.featurize(sim)
             logits, _, lstm2 = self.policy.apply(
                 params, obs, lstm, method="step"
             )
-            acts, logp = D.sample(k_act, logits, obs)
+            acts, logp = sample_per_game(k_act, logits, obs, spec.n_games)
             packed = jnp.stack(
                 [acts[h] for h in D.HEADS], axis=1
             ).astype(jnp.int32)
@@ -283,7 +414,9 @@ class DeviceActor:
                 ologits, _, opp_lstm2 = self.policy.apply(
                     opp_params, oobs, opp_lstm, method="step"
                 )
-                oacts, _ = D.sample(k_opp, ologits, oobs)
+                oacts, _ = sample_per_game(
+                    k_opp, ologits, oobs, spec.n_games
+                )
                 opacked = jnp.stack(
                     [oacts[h] for h in D.HEADS], axis=1
                 ).astype(jnp.int32)
@@ -345,13 +478,13 @@ class DeviceActor:
                 "win": win_g,
                 "ep_len": ep_len_g,
                 "ep_return": jnp.where(done_g, owner_ret, 0.0),
-                # per-term reward sums over the learner lanes (scalars)
-                "rew_terms": {
-                    term: arr.sum() for term, arr in r_terms.items()
-                },
+                # per-term rewards kept PER-LANE [L]: the post-scan sums
+                # reduce only the step axis, so the accumulators stay
+                # shard-local partials under the lane sharding
+                "rew_terms": r_terms,
             }
             ep_ret = jnp.where(done_lane, 0.0, ep_ret)
-            return (sim3, lstm3, opp_lstm3, key, ep_ret, ep_steps3), out
+            return (sim3, lstm3, opp_lstm3, key2, ep_ret, ep_steps3), out
 
         (sim_f, lstm_f, opp_f, key_f, ep_ret_f, ep_steps_f), outs = jax.lax.scan(
             body,
@@ -384,26 +517,31 @@ class DeviceActor:
             "carry0": carry0,
         }
         lg = self._league_game_mask[None, :]     # [1, N] non-anchor games
+        # Stats are PER-GAME/PER-LANE partials (ISSUE 18): only the step
+        # axis reduces here, the game/lane axis survives — under the lane
+        # sharding every accumulation is shard-local and the rollout half
+        # of the fused program emits NO collective. The host folds the
+        # surviving axis at drain time (reduce_device_stats).
         stats = {
-            "episodes": outs["ep_done"].sum().astype(jnp.float32),
-            "wins": outs["win"].sum().astype(jnp.float32),
-            "reward_sum": outs["reward"].sum(),
-            "ep_return_sum": outs["ep_return"].sum(),
+            "episodes": outs["ep_done"].sum(0).astype(jnp.float32),
+            "wins": outs["win"].sum(0).astype(jnp.float32),
+            "reward_sum": outs["reward"].sum(0),
+            "ep_return_sum": outs["ep_return"].sum(0),
             # snapshot-attributable outcomes only (anchor games excluded)
-            "league_episodes": (outs["ep_done"] & lg).sum().astype(jnp.float32),
-            "league_wins": (outs["win"] & lg).sum().astype(jnp.float32),
+            "league_episodes": (outs["ep_done"] & lg).sum(0).astype(jnp.float32),
+            "league_wins": (outs["win"] & lg).sum(0).astype(jnp.float32),
         }
         # outcome plane (ISSUE 15): done-masked per-bucket reductions +
         # episode-length histogram + the per-term reward decomposition —
         # all accumulated on device, drained with the existing stats sync
         stats.update(
-            outcome_ingraph.chunk_outcome_stats(
+            outcome_ingraph.chunk_outcome_partials(
                 outs["ep_done"], outs["win"], outs["ep_len"],
                 self._outcome_masks,
             )
         )
         stats["out_reward_terms"] = {
-            term: outs["rew_terms"][term].sum()
+            term: outs["rew_terms"][term].sum(0)
             for term in outcome_records.REWARD_TERMS
         }
         cum_stats = jax.tree.map(
@@ -459,9 +597,23 @@ class DeviceActor:
                 lambda t: jax.tree.map(jnp.copy, t)
             )
         dev = self._stats_copy(self.state.stats)
-        self.state = self.state._replace(stats=self._zero_stats())
+        fresh = self._zero_stats()
+        if self.mesh is not None:
+            # commit the zeroed accumulators back to the lane sharding —
+            # uncommitted host zeros would change the collect program's
+            # input layout and force a recompile on the next dispatch
+            fresh = jax.device_put(
+                fresh,
+                actor_state_sharding(
+                    self.state, self.mesh, self.mesh_config
+                ).stats,
+            )
+        self.state = self.state._replace(stats=fresh)
 
         def finish(s) -> Dict[str, float]:
+            # the fetched accumulators are per-game/per-lane partials —
+            # fold the game/lane axes on the host before any consumer
+            s = reduce_device_stats(s)
             self.episodes_done += int(s["episodes"])
             self.wins += int(s["wins"])
             self._reward_sum += float(s["ep_return_sum"])

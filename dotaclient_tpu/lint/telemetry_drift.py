@@ -132,9 +132,9 @@ _DOC_KEY_RE = re.compile(
 # namespace must be added here when its first key is minted.
 KEY_PREFIXES = (
     "actor/", "advantage/", "alerts/", "buffer/", "checkpoint/",
-    "compile/", "faults/", "fleet/", "health/", "league/", "learner/",
-    "mem/", "mesh/", "outcome/", "serve/", "shm/", "snapshot/", "span/",
-    "trace/", "transport/", "util/",
+    "compile/", "faults/", "fleet/", "fused/", "health/", "league/",
+    "learner/", "mem/", "mesh/", "outcome/", "serve/", "shm/",
+    "snapshot/", "span/", "trace/", "transport/", "util/",
 )
 # single-line inline code only: multi-line matches would mispair across
 # ``` fence lines (odd backtick count flips pairing for the whole doc)

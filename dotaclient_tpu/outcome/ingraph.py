@@ -54,34 +54,59 @@ def bucket_masks(
     }
 
 
-def zero_outcome_stats() -> Dict[str, jnp.ndarray]:
-    """The outcome slice of the device actor's stats accumulator."""
-    z = jnp.zeros((), jnp.float32)
+def zero_outcome_stats(n_games: Optional[int] = None) -> Dict[str, jnp.ndarray]:
+    """The outcome slice of the device actor's stats accumulator.
+
+    Scalar-shaped by default (the historical drain contract). With
+    ``n_games`` the accumulator is PER-GAME partials — ``[N]`` counters and
+    an ``[N, N_LEN_BUCKETS]`` histogram — the lane-sharded fused layout:
+    each mesh shard accumulates its own games' rows and nothing in the
+    rollout program ever reduces across the game axis (no collective); the
+    host sums the game axis at drain time (:func:`reduce_outcome_stats`).
+    The partial shapes are shard-count independent, so a checkpointed
+    accumulator restores across mesh sizes unchanged.
+    """
+    if n_games is None:
+        z = jnp.zeros((), jnp.float32)
+        hist = jnp.zeros((N_LEN_BUCKETS,), jnp.float32)
+    else:
+        z = jnp.zeros((n_games,), jnp.float32)
+        hist = jnp.zeros((n_games, N_LEN_BUCKETS), jnp.float32)
     out: Dict[str, jnp.ndarray] = {}
     for bucket in BUCKETS:
         out[f"out_eps_{bucket}"] = z
         out[f"out_wins_{bucket}"] = z
     out["out_ep_len_sum"] = z
-    out["out_ep_len_hist"] = jnp.zeros((N_LEN_BUCKETS,), jnp.float32)
+    out["out_ep_len_hist"] = hist
     return out
 
 
-def chunk_outcome_stats(
+def chunk_outcome_partials(
     ep_done: jnp.ndarray,
     win: jnp.ndarray,
     ep_len: jnp.ndarray,
     masks: Optional[Dict[str, jnp.ndarray]] = None,
 ) -> Dict[str, jnp.ndarray]:
-    """Done-masked outcome reductions over one chunk's episode stream.
+    """Done-masked PER-GAME outcome reductions over one chunk's stream.
 
     ``ep_done``/``win`` are boolean ``[..., N]`` (any leading step axes),
     ``ep_len`` the integer episode length in env steps at the done site
     (0 where not done). ``masks`` are the static per-game bucket masks
     ([N], broadcast across leading axes); ``None`` buckets everything
     vs_scripted (the parity tests' single-bucket mode).
+
+    Only the LEADING (step) axes are reduced — the game axis survives, so
+    under the lane-sharded fused layout every reduction is shard-local:
+    counters come out ``[N]``, the length histogram ``[N, N_LEN_BUCKETS]``
+    (a one-hot bucket sum per game — a scatter-add across games would
+    gather the whole batch onto every device). Every accumulated value is
+    an exact small-integer count/length in f32, so summing the game axis
+    later (:func:`reduce_outcome_stats`) is bitwise independent of how the
+    games were sharded.
     """
     done_f = ep_done.astype(jnp.float32)
     win_f = (win & ep_done).astype(jnp.float32)
+    lead = tuple(range(done_f.ndim - 1))
     out: Dict[str, jnp.ndarray] = {}
     for bucket in BUCKETS:
         if masks is None:
@@ -93,27 +118,58 @@ def chunk_outcome_stats(
         else:
             m = masks[bucket]
         mf = m.astype(jnp.float32)
-        out[f"out_eps_{bucket}"] = (done_f * mf).sum()
-        out[f"out_wins_{bucket}"] = (win_f * mf).sum()
+        out[f"out_eps_{bucket}"] = (done_f * mf).sum(lead)
+        out[f"out_wins_{bucket}"] = (win_f * mf).sum(lead)
     lens = ep_len.astype(jnp.float32) * done_f
-    out["out_ep_len_sum"] = lens.sum()
+    out["out_ep_len_sum"] = lens.sum(lead)
     # power-of-two bucket index via EXACT integer threshold compares —
     # idx = #{i >= 1 : len >= 2^i} == bit_length-1 clipped, the host
     # convention (records.len_bucket). A float log2 formulation would be
     # 1 ulp from flipping a bucket at exact power-of-two lengths on
     # backends with approximated transcendentals (TPU) — and timeout-
     # adjudicated episodes all share ONE exact length, so a single flip
-    # would move every one of them (review finding). Non-done slots are
-    # masked out of the scatter-add by weight 0, so their index never
-    # matters.
+    # would move every one of them (review finding). Non-done slots carry
+    # one-hot weight 0, so their index never matters.
     safe = jnp.maximum(ep_len, 1).astype(jnp.int32)
     idx = sum(
         (safe >= (1 << i)).astype(jnp.int32)
         for i in range(1, N_LEN_BUCKETS)
     )
-    out["out_ep_len_hist"] = (
-        jnp.zeros((N_LEN_BUCKETS,), jnp.float32)
-        .at[idx.reshape(-1)]
-        .add(done_f.reshape(-1))
-    )
+    onehot = (
+        idx[..., None] == jnp.arange(N_LEN_BUCKETS, dtype=jnp.int32)
+    ).astype(jnp.float32)
+    out["out_ep_len_hist"] = (onehot * done_f[..., None]).sum(lead)
     return out
+
+
+def reduce_outcome_stats(
+    partials: Dict[str, jnp.ndarray]
+) -> Dict[str, jnp.ndarray]:
+    """Fold the game axis out of per-game partials: counters ``[N]`` →
+    scalars, histogram ``[N, B]`` → ``[B]`` — the shapes
+    ``records.fold_device_stats`` consumes. Works on device arrays and on
+    host numpy alike (the drain reduces AFTER the fetch). Scalar-shaped
+    inputs pass through unchanged, so the reducer is safe on either
+    accumulator layout."""
+    out: Dict[str, jnp.ndarray] = {}
+    for k, v in partials.items():
+        if k == "out_ep_len_hist":
+            out[k] = v.sum(axis=0) if v.ndim == 2 else v
+        else:
+            out[k] = v.sum() if getattr(v, "ndim", 0) else v
+    return out
+
+
+def chunk_outcome_stats(
+    ep_done: jnp.ndarray,
+    win: jnp.ndarray,
+    ep_len: jnp.ndarray,
+    masks: Optional[Dict[str, jnp.ndarray]] = None,
+) -> Dict[str, jnp.ndarray]:
+    """Scalar-shaped outcome reductions (the historical contract): the
+    per-game partials with the game axis summed out. Bitwise identical to
+    the pre-partials formulation — every partial is an exact integer-valued
+    count in f32."""
+    return reduce_outcome_stats(
+        chunk_outcome_partials(ep_done, win, ep_len, masks)
+    )

@@ -23,6 +23,7 @@ from dotaclient_tpu.parallel.mesh import (
     data_sharding,
     make_mesh,
     replicated,
+    row_sharding,
 )
 from dotaclient_tpu.parallel.pipeline import make_pipeline, stack_stage_params
 from dotaclient_tpu.parallel.sequence import (
@@ -45,6 +46,7 @@ __all__ = [
     "make_ulysses_attention",
     "param_spec",
     "replicated",
+    "row_sharding",
     "stack_stage_params",
     "state_shardings",
 ]

@@ -103,6 +103,21 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def row_sharding(mesh: Mesh, config: MeshConfig, n_rows: int) -> NamedSharding:
+    """Leading-axis sharding for an ``n_rows``-row array: data-sharded when
+    the rows split evenly over the batch shards, replicated otherwise.
+
+    The single divisibility rule behind the lane-sharded actor state
+    (actor.device_rollout.actor_state_sharding): a game/lane axis that
+    divides the (dcn×)data shard count lives partitioned, anything else —
+    true scalars, the sim's batch-wide PRNG key, degenerate tiny layouts —
+    stays replicated rather than failing mid-compile."""
+    n = batch_shard_count(mesh, config)
+    if n_rows > 0 and n_rows % n == 0:
+        return data_sharding(mesh, config)
+    return replicated(mesh)
+
+
 def collective_probe_ms(mesh: Mesh, config: MeshConfig) -> float:
     """Measure one cross-mesh all-reduce round trip (dispatch → replicated
     result on the host), in milliseconds.

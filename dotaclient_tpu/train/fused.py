@@ -23,11 +23,13 @@ Trade-offs vs the buffered path (why both exist):
   * ``epochs_per_batch`` > 1 runs as a ``lax.scan`` of update steps over
     the same chunk INSIDE the program (epoch 2+ are the standard PPO
     re-uses, ratio clipped against the rollout's behavior_logp);
-    ``minibatches`` > 1 shuffles IN-PROGRAM: each epoch draws a fresh
-    lane permutation (keyed on ``config.seed`` and the optimizer step, so
-    it is deterministic and needs no host shuffle point or carried RNG),
-    splits the chunk into M equal lane groups, and scans an optimizer
-    step per group — the standard PPO minibatch pass, fully fused;
+    ``minibatches`` > 1 shuffles IN-PROGRAM and SHARD-LOCALLY
+    (``lane_minibatches``): each epoch every mesh shard draws a fresh
+    permutation of its own lanes (keyed on ``config.seed`` and the
+    optimizer step, so it is deterministic and needs no host shuffle
+    point or carried RNG) and contributes its m-th local group to
+    minibatch m — the standard PPO minibatch pass, fully fused, with no
+    cross-device gather;
   * ``RunConfig.steps_per_dispatch`` > 1 scans K whole rollout+update
     iterations per dispatch, amortizing the host↔device round trip K× at
     the cost of K-step granularity for everything host-side (opponent
@@ -44,14 +46,60 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from dotaclient_tpu.actor.device_rollout import actor_state_sharding
 from dotaclient_tpu.config import RunConfig
 from dotaclient_tpu.models.policy import Policy
-from dotaclient_tpu.parallel.mesh import data_sharding, replicated
+from dotaclient_tpu.parallel.mesh import (
+    batch_shard_count,
+    data_sharding,
+    replicated,
+)
 from dotaclient_tpu.train.ppo import (
     _train_step,
     fold_scan_metrics,
     train_state_sharding,
 )
+
+
+def lane_minibatches(chunk, step, seed: int, n_lanes: int, n_shards: int,
+                     n_mb: int):
+    """Shard-LOCAL in-program minibatch shuffle: permute lanes within each
+    mesh shard, never across — the gather stays on the local axis, so
+    minibatching adds NO collective to the hot loop (the only one left per
+    update is ``_train_step``'s gradient psum).
+
+    Each shard draws its own permutation of its ``n_lanes // n_shards``
+    local lanes (keyed on the run seed and the optimizer step at epoch
+    entry — strictly increasing, so every epoch of every iteration draws
+    fresh with no host shuffle point or carried RNG). Minibatch ``m`` is
+    the concatenation of every shard's ``m``-th local group, so each
+    minibatch is itself an evenly lane-sharded batch and the downstream
+    sharding constraint is a no-op assertion. The permutation stream is
+    shard-count DEPENDENT by design (the blocks are the shards); cross-
+    shard-count parity probes run with ``minibatches=1``, where the math
+    is shard-count invariant.
+
+    Returns the chunk reshaped to ``[n_mb, n_lanes // n_mb, ...]`` leaves.
+    """
+    S, Ls = n_shards, n_lanes // n_shards
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+    perm = jax.vmap(
+        lambda k: jax.random.permutation(k, Ls)
+    )(jax.random.split(key, S))                     # [S, Ls] per-shard perms
+
+    def shuffle(x):
+        xs = x.reshape((S, Ls) + x.shape[1:])
+        idx = perm.reshape((S, Ls) + (1,) * (x.ndim - 1))
+        xs = jnp.take_along_axis(xs, idx, axis=1)   # local-axis gather
+        xs = xs.reshape((S, n_mb, Ls // n_mb) + x.shape[1:])
+        # [S, M, Ls/M] → [M, S·Ls/M]: minibatch m owns every shard's m-th
+        # group; the sharded axis stays outermost of the merged dim, so the
+        # result is born lane-sharded
+        return jnp.moveaxis(xs, 0, 1).reshape(
+            (n_mb, S * (Ls // n_mb)) + x.shape[1:]
+        )
+
+    return jax.tree.map(shuffle, chunk)
 
 
 def make_fused_step(
@@ -61,10 +109,12 @@ def make_fused_step(
     metrics, stats) against ``mesh``.
 
     The train state keeps the TP/DP shardings of ``make_train_step``; the
-    chunk produced mid-program is constrained to the batch sharding so the
-    PPO update runs exactly as it would on a buffered batch; the actor's
-    sim/carry state is replicated (its arrays are small and the rollout
-    math is elementwise over lanes). ``opp_params`` must always be passed —
+    actor state is pinned LANE-SHARDED (``actor_state_sharding``): games —
+    and the game-major lanes they own — partition over the (dcn×)data axes,
+    so sim stepping, featurize, the policy forward, sampling, and the
+    in-graph outcome partials all compute on local lanes only and the chunk
+    is BORN data-sharded; the mid-program sharding constraints are no-op
+    assertions, not reshards. ``opp_params`` must always be passed —
     self-play callers pass the live params (the jitted program has one
     signature for both modes).
     """
@@ -75,15 +125,30 @@ def make_fused_step(
     ds = data_sharding(mesh, config.mesh)
     repl = replicated(mesh)
     st_sh = train_state_sharding(policy, config, mesh)
+    st_act_sh = actor_state_sharding(actor.state, mesh, config.mesh)
 
     n_epochs = config.ppo.epochs_per_batch
     n_mb = max(1, config.ppo.minibatches)
     n_iters = config.steps_per_dispatch
+    n_shards = batch_shard_count(mesh, config.mesh)
     L = actor.n_lanes
-    if L % n_mb:
+    N = actor.spec.n_games
+    # Lane sharding engages when the games (and their game-major lanes)
+    # split evenly over the batch shards; otherwise the per-leaf
+    # divisibility rule in actor_state_sharding has already degraded the
+    # layout to replicated (tiny debug configs — e.g. 4 games on an
+    # 8-device mesh) and the minibatch split treats the chunk as one
+    # shard, exactly the pre-sharding behavior.
+    lane_sharded = N % n_shards == 0 and L % n_shards == 0
+    eff_shards = n_shards if lane_sharded else 1
+    if L % (eff_shards * n_mb):
         raise ValueError(
-            f"fused minibatching splits the {L}-lane chunk along lanes: "
-            f"n_lanes must be divisible by minibatches ({n_mb})"
+            f"fused minibatching splits the {L}-lane chunk along lanes "
+            f"WITHIN each of the {eff_shards} lane shard(s): n_lanes must "
+            f"be divisible by data_parallel x minibatches "
+            f"({eff_shards} x {n_mb} = {eff_shards * n_mb}) so every shard "
+            f"contributes equal lane groups to each of the {n_mb} "
+            f"minibatch(es)"
         )
 
     probe = config.health.enabled
@@ -101,22 +166,17 @@ def make_fused_step(
                     policy, config.ppo, st, chunk,
                     anchor_params=anchor_params, probe=probe,
                 )
-            # In-program shuffle: the permutation is keyed on the run seed
-            # and the optimizer step at epoch entry (strictly increasing,
-            # so every epoch of every iteration draws fresh) — no host
-            # shuffle point, no extra carried RNG state.
-            key = jax.random.fold_in(
-                jax.random.PRNGKey(config.seed), st.step
-            )
-            perm = jax.random.permutation(key, L)
-            mbs = jax.tree.map(
-                lambda x: jnp.take(x, perm, axis=0).reshape(
-                    (n_mb, L // n_mb) + x.shape[1:]
-                ),
-                chunk,
+            # In-program shuffle, shard-local (lane_minibatches): each mesh
+            # shard permutes its own lanes and contributes its m-th group
+            # to minibatch m — no cross-device gather enters the hot loop.
+            mbs = lane_minibatches(
+                chunk, st.step, config.seed, L, eff_shards, n_mb
             )
 
             def mb_step(s, mb):
+                # no-op assertion under the lane-sharded layout (each
+                # minibatch is born evenly lane-sharded); kept as the
+                # contract pin rather than trusting propagation
                 mb = jax.tree.map(
                     lambda x: jax.lax.with_sharding_constraint(x, ds), mb
                 )
@@ -140,6 +200,9 @@ def make_fused_step(
         actor_state, chunk, stats = actor._rollout_impl(
             state.params, actor_state, opp_params
         )
+        # no-op assertion: the chunk is BORN data-sharded (its lanes
+        # inherit the actor state's lane sharding); this pin turns a
+        # layout regression into a visible reshard instead of silence
         chunk = jax.tree.map(
             lambda x: jax.lax.with_sharding_constraint(x, ds), chunk
         )
@@ -178,8 +241,13 @@ def make_fused_step(
     # opp_params shards like the live params (st_sh's params subtree): under
     # TP, pinning it replicated would all-gather the full param set every
     # step — on the one-dispatch hot path this module exists to shorten.
+    # The actor state is pinned lane-sharded in AND out (st_act_sh): the
+    # sim worlds, carries, per-game keys, and stat partials live
+    # partitioned in HBM across dispatches; the per-chunk stats output
+    # keeps the same partial layout (its game/lane axes are the sharded
+    # ones), so emitting it is collective-free too.
     return jax.jit(
         fused,
-        in_shardings=(st_sh, repl, st_sh.params),
-        out_shardings=(st_sh, repl, repl, repl),
+        in_shardings=(st_sh, st_act_sh, st_sh.params),
+        out_shardings=(st_sh, st_act_sh, repl, st_act_sh.stats),
     )

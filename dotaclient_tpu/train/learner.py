@@ -196,6 +196,12 @@ class Learner:
         reg.gauge("learner/psum_ms").set(
             collective_probe_ms(self.mesh, config.mesh)
         )
+        # Lane-sharded actor geometry (ISSUE 18): eager-created so any
+        # learner JSONL validates --require-multichip; they stay 0 for
+        # modes without a device-resident actor and are set to the real
+        # lane split when the DeviceActor is constructed below.
+        reg.gauge("mesh/lane_shards")
+        reg.gauge("fused/lanes_per_shard")
         if config.ppo.minibatches > 1:
             # each minibatch is itself a data-sharded train batch. In fused
             # mode the chunk IS the lane set, split along lanes in-program
@@ -506,8 +512,21 @@ class Learner:
         elif mode in ("device", "fused"):
             from dotaclient_tpu.actor.device_rollout import DeviceActor
 
-            self.device_actor = DeviceActor(config, self.policy, seed=seed)
+            # the actor state is committed lane-sharded over the learner's
+            # mesh (ISSUE 18): games partition over the (dcn×)data axes, so
+            # the fused program's pinned shardings are satisfied by layout
+            self.device_actor = DeviceActor(
+                config, self.policy, seed=seed,
+                mesh=self.mesh, mesh_config=config.mesh,
+            )
             self.pool: Any = self.device_actor  # shared stats() surface
+            reg = telemetry.get_registry()
+            reg.gauge("mesh/lane_shards").set(
+                float(self.device_actor.lane_shards)
+            )
+            reg.gauge("fused/lanes_per_shard").set(
+                float(self.device_actor.lanes_per_shard)
+            )
             if mode == "fused":
                 from dotaclient_tpu.train.fused import make_fused_step
 
@@ -1010,12 +1029,27 @@ class Learner:
         if self.buffer is not None and "buffer" in restored:
             self.buffer.load_state_dict(restored["buffer"])
         if self.device_actor is not None and "actor_leaves" in restored:
+            from dotaclient_tpu.actor.device_rollout import (
+                actor_state_sharding,
+            )
+
             treedef = jax.tree.structure(self.device_actor.state)
-            leaves = [
-                jnp.asarray(restored["actor_leaves"][k])
-                for k in sorted(restored["actor_leaves"])
-            ]
-            self.device_actor.state = jax.tree.unflatten(treedef, leaves)
+            state = jax.tree.unflatten(
+                treedef,
+                [
+                    np.asarray(restored["actor_leaves"][k])
+                    for k in sorted(restored["actor_leaves"])
+                ],
+            )
+            # re-commit through THIS mesh's lane sharding (ISSUE 18): the
+            # saved host leaves are layout-free, so a checkpoint written at
+            # a different device count lands partitioned — not replicated —
+            # before the first fused dispatch (the train-state analogue is
+            # state_shardings re-commit above / in the rollback path)
+            self.device_actor.state = jax.device_put(
+                state,
+                actor_state_sharding(state, self.mesh, self.config.mesh),
+            )
         if "mb_draws" in restored:
             # fast-forward the seeded shuffle stream to its saved position
             self._mb_draws = int(np.asarray(restored["mb_draws"]))
@@ -1373,11 +1407,12 @@ class Learner:
         fetched = jax.device_get([st for _, st in pending])  # one sync
         for (idx, _), st in zip(pending, fetched):
             # anchor games (scripted-bot opponents) are excluded from the
-            # snapshot's PFSP record — it never played them
+            # snapshot's PFSP record — it never played them. Chunk stats
+            # are per-game partials (ISSUE 18) — fold the game axis here.
             self.league.report(
                 idx,
-                float(st.get("league_wins", st["wins"])),
-                float(st.get("league_episodes", st["episodes"])),
+                float(np.sum(st.get("league_wins", st["wins"]))),
+                float(np.sum(st.get("league_episodes", st["episodes"]))),
             )
 
     def _refresh_league_opponent(self) -> None:

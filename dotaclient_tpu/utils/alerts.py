@@ -269,6 +269,10 @@ ALERT_WAIVERS: Dict[str, str] = {
         "registry level encodes it"
     ),
     "rb:tpu-preflight": "startup tool (run_multichip.py), not a live signal",
+    "rb:fused-lane-divisibility": (
+        "construction-time ValueError before any compile; the process "
+        "never reaches a runtime level to watch"
+    ),
     "rb:serve-stuck-window": (
         "needs a cross-rate comparison (requests vs dispatches) the rule "
         "grammar deliberately excludes; p99 blowups page via "

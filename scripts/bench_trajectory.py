@@ -54,6 +54,8 @@ RATIO_STAGES = (
     "advantage_parity",
     "multichip_parity",
     "scaling_efficiency",
+    "fused_multichip_parity",
+    "fused_scaling_efficiency",
     "serve_parity",
     "prefetch_hit_rate",
     "overlap_fraction",

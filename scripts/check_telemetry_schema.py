@@ -164,9 +164,11 @@ HEALTH_KEYS = (
     "buffer/stale_rejected_total",      # admission-control staleness drops
 )
 
-# Multi-chip learner (ISSUE 10). Validated with --require-multichip
-# against ANY learner run's JSONL: the Learner eager-creates all four at
-# construction (mesh geometry + the one-time startup all-reduce probe;
+# Multi-chip learner (ISSUE 10; lane-sharding gauges PR 18). Validated
+# with --require-multichip against ANY learner run's JSONL: the Learner
+# eager-creates every key here at construction (mesh geometry, the
+# lane-sharding layout — 0s outside device/fused modes — and the
+# one-time startup all-reduce probe;
 # buffer/shard_bytes stays 0 for bufferless fused runs and carries the
 # per-device resident ring bytes otherwise), so presence is deterministic
 # at every device count — a 1-device mesh is the degenerate case of the
@@ -174,6 +176,8 @@ HEALTH_KEYS = (
 MULTICHIP_KEYS = (
     "mesh/n_devices",        # devices in the learner's mesh
     "mesh/data_shards",      # batch shard count (dcn × data axes)
+    "mesh/lane_shards",      # fused actor-state lane shard count (PR 18)
+    "fused/lanes_per_shard", # local lanes per shard (0 in non-device modes)
     "buffer/shard_bytes",    # per-device resident bytes of the HBM ring
     "learner/psum_ms",       # startup probe: one mesh all-reduce round trip
 )

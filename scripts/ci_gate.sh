@@ -68,6 +68,15 @@ fi
 python scripts/bench_trajectory.py --gate
 report bench_gate $?
 
+# -- stage 5: fused lane-sharding parity (PR 18) ---------------------------
+# The 1-vs-2 forced-host shape of the fused-parity verdict: the
+# lane-sharded one-dispatch program must produce a matching rollout
+# digest (1e-7 relative), Adam-tolerance losses, a 1e-5 param checksum,
+# AND the compiled lane-sharding proof. bench.py's fused_multichip stage runs
+# the same tool at 1-vs-8; this is the fast always-on pin.
+python scripts/run_multichip.py --fused-parity 2 --steps 2 --parity-steps 2
+report fused_parity $?
+
 echo "== ci_gate summary =="
 for line in "${SUMMARY[@]}"; do
     echo "  $line"

@@ -29,10 +29,27 @@ Three modes, one JSON result line each (the driver-record shape of
   probe process initializes its backend; ``--devices`` only *asserts*
   the count.
 
+* **--fused**: same measurement contract for the ONE-dispatch fused
+  program (``train/fused.make_fused_step``, ``actor="fused"``): the whole
+  rollout+update iteration runs lane-sharded over this process's devices,
+  and the payload carries the compiled ``lane_sharded`` PROOF read off
+  ``input_shardings`` — the actor state's lane arrays must be
+  data-sharded, not replicated.
+* **--fused-parity N**: one-command verdict — spawns the fused probe at 1
+  and N forced host devices (fresh subprocess each, env-pinned before
+  backend init), compares per-dispatch losses + float64 param-L1 at
+  reassociation tolerance, and requires the lane-sharding proof at N.
+  Shared by ``scripts/ci_gate.sh`` (fused-parity stage) and ``bench.py``
+  (fused_multichip stage).
+* **--dcn-slices M** (probe modes): build the 3-axis (dcn, data, model)
+  mesh — the multi-host spelling, exercisable single-host because forced
+  host devices reshape the same way.
+
 Usage:
     python scripts/run_multichip.py                  # real-backend dry run
     python scripts/run_multichip.py --force-host 8   # zero-TPU fallback
     python scripts/run_multichip.py --probe --steps 10   # bench probe
+    python scripts/run_multichip.py --fused-parity 8     # fused verdict
 """
 
 from __future__ import annotations
@@ -232,18 +249,37 @@ def preflight(n_devices: int, force_host: Optional[int]) -> int:
     return _result(payload)
 
 
-def probe(expect_devices: Optional[int], n_steps: int, parity_steps: int) -> int:
-    """Measure the sharded fused epoch step on this process's devices."""
-    import time
-
+def _probe_config(dcn_slices: int):
+    """The probe's RunConfig: the default shapes with E=2/M=2 (the
+    production multi-update program) and, with ``--dcn-slices``, the
+    (dcn, data, model) mesh — the one-command multi-host spelling."""
     import dataclasses
-
-    import jax
-    import numpy as np
 
     if REPO not in sys.path:  # direct `python scripts/...` invocation
         sys.path.insert(0, REPO)
     from dotaclient_tpu.config import default_config
+
+    config = default_config()
+    return dataclasses.replace(
+        config,
+        ppo=dataclasses.replace(
+            config.ppo, epochs_per_batch=2, minibatches=2
+        ),
+        mesh=dataclasses.replace(config.mesh, dcn_slices=dcn_slices),
+    )
+
+
+def probe(
+    expect_devices: Optional[int], n_steps: int, parity_steps: int,
+    dcn_slices: int = 1,
+) -> int:
+    """Measure the sharded fused epoch step on this process's devices."""
+    import time
+
+    import jax
+    import numpy as np
+
+    config = _probe_config(dcn_slices)
     from dotaclient_tpu.models import init_params, make_policy
     from dotaclient_tpu.parallel import make_mesh
     from dotaclient_tpu.train import example_batch, init_train_state
@@ -263,16 +299,9 @@ def probe(expect_devices: Optional[int], n_steps: int, parity_steps: int) -> int
                 ),
             }
         )
-    # E×M > 1 so the probe exercises the production multi-update program
-    # (in-program minibatch gathers + per-update grad psum), with the
-    # learner's exact permutation-stream contract.
-    config = default_config()
-    config = dataclasses.replace(
-        config,
-        ppo=dataclasses.replace(
-            config.ppo, epochs_per_batch=2, minibatches=2
-        ),
-    )
+    # E×M > 1 (set in _probe_config) so the probe exercises the production
+    # multi-update program (in-program minibatch gathers + per-update grad
+    # psum), with the learner's exact permutation-stream contract.
     B, T = config.ppo.batch_rollouts, config.ppo.rollout_len
     E = config.ppo.epochs_per_batch
     mesh = make_mesh(config.mesh)
@@ -347,6 +376,296 @@ def probe(expect_devices: Optional[int], n_steps: int, parity_steps: int) -> int
     )
 
 
+def fused_probe(
+    expect_devices: Optional[int], n_steps: int, parity_steps: int,
+    dcn_slices: int = 1, rollout_len: int = 8,
+) -> int:
+    """Measure the ONE-dispatch fused program (rollout + PPO update,
+    ``train/fused.make_fused_step``) with the actor state LANE-SHARDED over
+    this process's devices.
+
+    Parity contract: ``minibatches=1`` — the shard-local permutation
+    stream (``lane_minibatches``) is shard-count dependent by design, so
+    cross-device-count digests compare the M=1 program, which is
+    shard-count invariant up to reduction reassociation in the gradient
+    psum. The payload carries the SHARDING PROOF (``lane_sharded``): read
+    from the compiled program's ``input_shardings`` — the actor-state
+    argument's lane arrays must be data-sharded, not replicated, on any
+    multi-device mesh.
+    """
+    import dataclasses
+    import time
+
+    import jax
+    import numpy as np
+
+    config = _probe_config(dcn_slices)
+    # fused-mode program shape (the probe builds DeviceActor +
+    # make_fused_step directly — no Learner)
+    config = dataclasses.replace(
+        config,
+        ppo=dataclasses.replace(
+            config.ppo, minibatches=1, rollout_len=rollout_len
+        ),
+    )
+    from dotaclient_tpu.actor.device_rollout import DeviceActor
+    from dotaclient_tpu.models import init_params, make_policy
+    from dotaclient_tpu.parallel import make_mesh
+    from dotaclient_tpu.train import init_train_state
+    from dotaclient_tpu.train.fused import make_fused_step
+    from dotaclient_tpu.train.ppo import train_state_sharding
+
+    n_devices = len(jax.devices())
+    if expect_devices is not None and n_devices != expect_devices:
+        return _result(
+            {
+                "ok": False,
+                "skipped": False,
+                "n_devices": n_devices,
+                "error": (
+                    f"probe expected {expect_devices} devices but the "
+                    f"backend initialized {n_devices} — set XLA_FLAGS/"
+                    f"JAX_PLATFORMS before spawning the probe"
+                ),
+            }
+        )
+    mesh = make_mesh(config.mesh)
+    policy = make_policy(config.model, config.obs, config.actions)
+    st_sh = train_state_sharding(policy, config, mesh)
+    actor = DeviceActor(
+        config, policy, seed=config.seed, mesh=mesh, mesh_config=config.mesh
+    )
+    step = make_fused_step(policy, config, mesh, actor)
+
+    state = jax.device_put(
+        init_train_state(
+            init_params(policy, jax.random.PRNGKey(config.seed)), config.ppo
+        ),
+        st_sh,
+    )
+    # Compile once, read the PROOF off the executable: the actor-state
+    # argument (position 1) must hold data-sharded lane arrays — a
+    # replicated layout here means the tentpole regressed to broadcast
+    # rollouts, even if the numbers still agree.
+    compiled = step.lower(state, actor.state, state.params).compile()
+    arg_shardings = compiled.input_shardings[0]
+    actor_arg = jax.tree.leaves(arg_shardings[1])
+    lane_sharded = any(not s.is_fully_replicated for s in actor_arg)
+
+    L, T = actor.n_lanes, config.ppo.rollout_len
+    frames_per_dispatch = L * T * config.steps_per_dispatch
+
+    # -- rollout digest: the STRONG invariant. GSPMD is value-preserving
+    # outside collectives and the lane-sharded rollout has none (per-game
+    # keys, per-lane sim/featurize/sample, partial stats), so the chunk a
+    # sharded rollout produces matches the 1-device chunk up to backend
+    # codegen (bitwise in-process; ~1e-9 relative across separately
+    # threaded probe processes) — gated far tighter than the post-Adam
+    # losses below.
+    _, chunk0, _ = jax.jit(actor._rollout_impl)(
+        state.params, actor.state, state.params
+    )
+    rollout_l1 = float(
+        sum(
+            np.abs(np.asarray(leaf, np.float64)).sum()
+            for leaf in jax.tree.leaves(jax.device_get(chunk0))
+        )
+    )
+    del chunk0
+
+    # -- parity digest: K deterministic dispatches from the fresh state ----
+    ast = actor.state
+    losses: List[float] = []
+    for _ in range(parity_steps):
+        state, ast, m, _stats = compiled(state, ast, state.params)
+        losses.append(float(np.asarray(m["loss"])))
+    param_l1 = float(
+        sum(
+            np.abs(np.asarray(leaf, np.float64)).sum()
+            for leaf in jax.tree.leaves(jax.device_get(state.params))
+        )
+    )
+
+    # -- throughput: warmed dispatches, best of 2 segments ------------------
+    state, ast, m, _stats = compiled(state, ast, state.params)   # settle
+    jax.block_until_ready(m["loss"])
+    fps = 0.0
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            state, ast, m, _stats = compiled(state, ast, state.params)
+        jax.block_until_ready(m["loss"])
+        fps = max(
+            fps, n_steps * frames_per_dispatch / (time.perf_counter() - t0)
+        )
+
+    return _result(
+        {
+            "ok": True,
+            "skipped": False,
+            "mode": "fused",
+            "n_devices": n_devices,
+            "mesh": {str(k): int(v) for k, v in mesh.shape.items()},
+            "lane_shards": int(actor.lane_shards),
+            "lanes_per_shard": int(actor.lanes_per_shard),
+            "lane_sharded": bool(lane_sharded),
+            "n_lanes": int(L),
+            "optimizer_frames_per_sec": round(fps, 1),
+            "parity": {
+                "losses": losses,
+                "param_l1": param_l1,
+                "rollout_l1": rollout_l1,
+            },
+        }
+    )
+
+
+def _fused_probe_subprocess(
+    n: int, n_steps: int, parity_steps: int, rollout_len: int
+) -> Tuple[int, str]:
+    """Spawn one fused probe on ``n`` FORCED HOST devices in a fresh
+    process — the device count must be pinned via env before the child
+    initializes its backend (a cached backend makes any later pin inert)."""
+    env = {
+        "XLA_FLAGS": (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n}"
+        ).strip(),
+        "JAX_PLATFORMS": "cpu",
+    }
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable, os.path.abspath(__file__), "--fused",
+                "--devices", str(n), "--steps", str(n_steps),
+                "--parity-steps", str(parity_steps),
+                "--rollout-len", str(rollout_len),
+            ],
+            cwd=REPO,
+            env={**os.environ, **env},
+            capture_output=True,
+            text=True,
+            timeout=900.0,
+        )
+    except subprocess.TimeoutExpired as e:
+        partial = "".join(
+            p.decode(errors="replace") if isinstance(p, bytes) else (p or "")
+            for p in (e.stdout, e.stderr)
+        )
+        return -1, f"MULTICHIP_PREFLIGHT_TIMEOUT after 900s\n{partial}"
+    return proc.returncode, proc.stdout + proc.stderr
+
+
+def _last_json_line(out: str) -> Optional[dict]:
+    for line in reversed(out.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def fused_parity(
+    n_high: int, n_steps: int, parity_steps: int, rollout_len: int = 8
+) -> int:
+    """One-command parity verdict: run the fused probe at 1 and at
+    ``n_high`` forced host devices (fresh subprocess each — the sharded
+    program must be numerically the 1-device program), compare per-dispatch
+    losses and the float64 param-L1 checksum at reassociation tolerance,
+    and require the ``n_high`` run's compiled lane-sharding proof.
+
+    Three-tier tolerance, each tier matched to where shard count can
+    enter the math:
+
+    * ``rollout_l1`` at 1e-7 relative — the lane-sharded rollout has NO
+      collective (per-game keys, per-lane sim/featurize/sample, partial
+      stats), so its chunk is value-identical to the 1-device chunk up
+      to backend codegen: within one process it is BITWISE
+      (tests/test_fused_multichip.py pins equality on the shared thread
+      pool), but across separately-threaded probe processes the CPU
+      backend tiles per-lane contractions differently at tiny local
+      batches (measured 3e-9 relative at 8 shards, exact at 2 and 4) —
+      far below the 1e-7 gate and orders tighter than anything a real
+      sharding bug (dropped lanes, divergent RNG) produces.
+    * per-dispatch losses at ``|a-b| <= max(1e-3, 2e-2·|a|)`` — each
+      dispatch crosses Adam updates whose gradient psum reassociates
+      (≈1e-7 gradient deltas), and Adam's ``1/(sqrt(v̂)+ε)`` amplifies
+      those on near-zero-gradient coordinates, so post-update losses
+      agree to ~1e-4 absolute, not machine level (measured headroom ≈3×).
+    * ``param_l1`` checksum at ``|c1-cN| <= 1e-5·max(1, |c1|)`` — the
+      bench multichip stage's tolerance.
+    """
+    probes = {}
+    for n in (1, n_high):
+        rc, out = _fused_probe_subprocess(n, n_steps, parity_steps,
+                                          rollout_len)
+        payload = _last_json_line(out)
+        if rc != 0 or not payload or not payload.get("ok"):
+            classified = classify_backend_error(out)
+            if classified is not None:
+                reason, remediation = classified
+                print(f"MULTICHIP SKIP: {reason}", file=sys.stderr)
+                print(f"  remediation: {remediation}", file=sys.stderr)
+                return _result(
+                    {
+                        "mode": "fused-parity",
+                        "ok": False,
+                        "skipped": True,
+                        "reason": reason,
+                        "remediation": remediation,
+                    }
+                )
+            return _result(
+                {
+                    "mode": "fused-parity",
+                    "ok": False,
+                    "skipped": False,
+                    "failed_probe_devices": n,
+                    "rc": rc,
+                    "tail": "\n".join(out.splitlines()[-12:]),
+                }
+            )
+        probes[n] = payload
+
+    l1 = probes[1]["parity"]["losses"]
+    ln = probes[n_high]["parity"]["losses"]
+    c1 = probes[1]["parity"]["param_l1"]
+    cn = probes[n_high]["parity"]["param_l1"]
+    r1 = probes[1]["parity"]["rollout_l1"]
+    rn = probes[n_high]["parity"]["rollout_l1"]
+    rollout_ok = abs(r1 - rn) <= 1e-7 * max(1.0, abs(r1))
+    losses_ok = len(l1) == len(ln) and all(
+        abs(a - b) <= max(1e-3, 2e-2 * abs(a)) for a, b in zip(l1, ln)
+    )
+    checksum_ok = abs(c1 - cn) <= 1e-5 * max(1.0, abs(c1))
+    lane_sharded = bool(probes[n_high].get("lane_sharded"))
+    max_abs = max(
+        (abs(a - b) for a, b in zip(l1, ln)), default=float("inf")
+    )
+    fps1 = probes[1]["optimizer_frames_per_sec"]
+    fpsn = probes[n_high]["optimizer_frames_per_sec"]
+    return _result(
+        {
+            "mode": "fused-parity",
+            "ok": rollout_ok and losses_ok and checksum_ok and lane_sharded,
+            "skipped": False,
+            "devices": [1, n_high],
+            "parity": {
+                "rollout_l1_ok": rollout_ok,
+                "losses_ok": losses_ok,
+                "param_l1_ok": checksum_ok,
+                "max_abs_loss_diff": max_abs,
+            },
+            "lane_sharded": lane_sharded,
+            "scaling_efficiency": round(fpsn / (fps1 * n_high), 4)
+            if fps1 > 0 else 0.0,
+            "probes": {str(k): v for k, v in probes.items()},
+        }
+    )
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument(
@@ -364,13 +683,47 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="measurement mode (bench.py's multichip stage): fused epoch "
         "step throughput + parity digest on this process's devices",
     )
+    p.add_argument(
+        "--fused", action="store_true",
+        help="measurement mode for the ONE-dispatch fused program "
+        "(rollout + update, actor='fused'): lane-sharded throughput + "
+        "parity digest + compiled lane-sharding proof",
+    )
+    p.add_argument(
+        "--fused-parity", type=int, default=None, metavar="N",
+        help="one-command verdict: spawn the fused probe at 1 and N forced "
+        "host devices (fresh subprocess each), compare digests at "
+        "reassociation tolerance, require the lane-sharding proof at N",
+    )
+    p.add_argument(
+        "--dcn-slices", type=int, default=1,
+        help="probe modes: build the (dcn, data, model) mesh with this "
+        "many DCN slices (multi-host spelling; device count must divide "
+        "dcn_slices x model_parallel)",
+    )
     p.add_argument("--steps", type=int, default=10,
-                   help="--probe: timed optimizer dispatches per segment")
+                   help="probe modes: timed optimizer dispatches per segment")
     p.add_argument("--parity-steps", type=int, default=3,
-                   help="--probe: deterministic steps in the parity digest")
+                   help="probe modes: deterministic steps in the parity "
+                   "digest")
+    p.add_argument("--rollout-len", type=int, default=8,
+                   help="--fused/--fused-parity: rollout chunk length T for "
+                   "the probe program")
     args = p.parse_args(argv)
+    if args.fused_parity is not None:
+        return fused_parity(
+            args.fused_parity, args.steps, args.parity_steps,
+            args.rollout_len,
+        )
+    if args.fused:
+        return fused_probe(
+            args.devices, args.steps, args.parity_steps, args.dcn_slices,
+            args.rollout_len,
+        )
     if args.probe:
-        return probe(args.devices, args.steps, args.parity_steps)
+        return probe(
+            args.devices, args.steps, args.parity_steps, args.dcn_slices
+        )
     return preflight(args.devices, args.force_host)
 
 

@@ -132,8 +132,9 @@ class TestFusedStep:
         actor_state0 = jax.tree.map(jnp.copy, actor.state)
         L = actor.n_lanes
 
-        # reference: collect, permute with the same key derivation, M
-        # sequential optimizer steps on the lane groups
+        # reference: collect, permute with the same shard-local derivation
+        # (one shard on this 1-device mesh), M sequential optimizer steps
+        # on the lane groups
         ref_state = init_train_state(params, cfg.ppo)
         _, chunk, _ = jax.jit(actor._rollout_impl)(
             ref_state.params, actor_state0, ref_state.params
@@ -141,7 +142,8 @@ class TestFusedStep:
         key = jax.random.fold_in(
             jax.random.PRNGKey(cfg.seed), ref_state.step
         )
-        perm = jax.random.permutation(key, L)
+        (shard_key,) = jax.random.split(key, 1)
+        perm = jax.random.permutation(shard_key, L)
         shuf = jax.tree.map(lambda x: jnp.take(x, perm, axis=0), chunk)
         step_jit = jax.jit(
             lambda s, b: _train_step(policy, cfg.ppo, s, b)

@@ -182,9 +182,12 @@ class TestLeagueAnchors:
         params = init_params(policy, jax.random.PRNGKey(0))
         frozen = init_params(policy, jax.random.PRNGKey(9))
         _, stats = da.collect(params, opp_params=frozen)
+        # chunk stats are per-game partials (ISSUE 18): anchor games must
+        # contribute episodes but never league-attributed ones
         s = jax.device_get(stats)
-        assert s["league_episodes"] <= s["episodes"]
-        assert s["league_wins"] <= s["wins"]
+        assert (s["league_episodes"] <= s["episodes"]).all()
+        assert (s["league_wins"] <= s["wins"]).all()
+        assert s["league_episodes"][: da.n_anchor_games].sum() == 0.0
 
     def test_vec_pool_anchor_games_pin_scripted_control(self):
         """The host vec pool honors anchor_prob the same way the device
