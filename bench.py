@@ -1311,6 +1311,141 @@ def bench_serve(config) -> dict:
     return out
 
 
+def bench_serve_fleet(config) -> dict:
+    """Serve-fleet stage (ISSUE 19): the routed fleet's throughput under a
+    mid-run backend death, the client-visible failover blackout, and the
+    re-home parity digest.
+
+    * **throughput + blackout** — two live backends and one hot spare
+      behind a ``SessionRouter``; a router-mode loadgen fleet attaches
+      through it, then backend 0 dies abruptly mid-run. actions/sec is
+      the honest whole-run number (kill included). The blackout is the
+      client-visible stall the failover causes: per client, the worst
+      reply latency completed after the kill instant; the p99 across
+      clients is the headline. Every request must still complete — a
+      deadline error in this stage is a failover bug, not noise.
+    * **re-home parity digest** — ``run_rehome_parity``
+      (scripts/serve_loadgen.py): the carry-shadow re-home must resume
+      bit-exact, pinned by reference_step replay with the teeth check.
+      Pass/fail; ``serve_fleet_rehome_parity`` is the gate CI reads.
+    """
+    import dataclasses
+    import threading
+
+    from dotaclient_tpu.models import init_params, make_policy
+    from dotaclient_tpu.serve import (
+        PolicyServer,
+        ServeEngine,
+        SessionRouter,
+        make_inference_policy,
+        slice_train_params,
+    )
+    from dotaclient_tpu.utils import telemetry
+    from scripts.serve_loadgen import run_loadgen, run_rehome_parity
+
+    n_clients, n_requests = 8, 60
+    cfg = dataclasses.replace(
+        config,
+        serve=dataclasses.replace(
+            config.serve,
+            batch_window_ms=0.5, max_batch=n_clients,
+            max_slots=2 * n_clients, carry_shadow=True,
+            request_deadline_s=30.0, request_retries=20,
+            router_probe_s=0.1, router_dead_after_s=0.4,
+        ),
+    )
+    full = make_policy(cfg.model, cfg.obs, cfg.actions)
+    params = slice_train_params(init_params(full, jax.random.PRNGKey(0)))
+    policy = make_inference_policy(cfg)
+
+    engines, servers, addrs = [], [], []
+    for _ in range(3):
+        reg = telemetry.Registry()
+        eng = ServeEngine(cfg, policy, params, registry=reg)
+        srv = PolicyServer(eng, cfg, port=0, registry=reg)
+        engines.append(eng)
+        servers.append(srv)
+        addrs.append(srv.address)
+    rreg = telemetry.Registry()
+    router = SessionRouter(
+        cfg, list(addrs[:2]), spares=[addrs[2]], registry=rreg
+    )
+    rhost, rport = router.address
+    out: dict = {}
+    try:
+        def _gauge(key):
+            return rreg.counters_and_gauges()[1].get(key, 0.0)
+
+        deadline = time.time() + 15.0
+        while time.time() < deadline and not (
+            _gauge("router/backends_live") >= 2
+            and _gauge("router/spares_available") >= 1
+        ):
+            time.sleep(0.05)
+
+        result: dict = {}
+
+        def _drive():
+            result.update(
+                run_loadgen(
+                    rhost, rport, cfg,
+                    n_clients=n_clients, requests_per_client=n_requests,
+                    router=True, max_reconnects=20,
+                    collect_samples=True, think_s=0.005,
+                )
+            )
+
+        t = threading.Thread(target=_drive, daemon=True)
+        t.start()
+        deadline = time.time() + 30.0
+        while (
+            time.time() < deadline
+            and t.is_alive()
+            and _gauge("router/sessions_active") < n_clients
+        ):
+            time.sleep(0.02)
+        t_kill = time.monotonic()
+        servers[0].close()
+        engines[0].stop()
+        t.join(timeout=180.0)
+
+        worst = {}  # client → worst post-kill reply latency (the blackout)
+        for t_end, latency, ci in result.get("samples", ()):
+            if t_end >= t_kill:
+                worst[ci] = max(worst.get(ci, 0.0), latency)
+        blackouts = sorted(worst.values())
+        n = len(blackouts)
+        out["actions_per_sec"] = result.get("actions_per_sec", 0.0)
+        out["replies"] = result.get("replies", 0)
+        out["errors"] = result.get("errors", 0)
+        out["deadline_errors"] = result.get("deadline_errors", 0)
+        out["sessions_rehomed"] = result.get("sessions_rehomed", 0)
+        out["blackout_p99_ms"] = (
+            round(blackouts[min(n - 1, int(n * 0.99))] * 1e3, 3) if n else 0.0
+        )
+        out["spares_promoted"] = int(
+            rreg.counters_and_gauges()[0].get(
+                "router/spares_promoted_total", 0
+            )
+        )
+        out["complete"] = 1.0 if (
+            result.get("replies", 0) == n_clients * n_requests
+            and result.get("errors", 0) == 0
+            and result.get("sessions_rehomed", 0) >= 1
+        ) else 0.0
+    finally:
+        router.close()
+        for srv in servers:
+            srv.close()
+        for eng in engines:
+            eng.stop()
+
+    digest = run_rehome_parity(seed=0)
+    out["rehome_parity"] = digest
+    out["rehome_parity_ok"] = 1.0 if digest.get("parity") == "bitwise" else 0.0
+    return out
+
+
 def main() -> None:
     from dotaclient_tpu.config import default_config
     from dotaclient_tpu.models import init_params, make_policy
@@ -1592,6 +1727,26 @@ def main() -> None:
     except Exception as e:
         serve = {"error": f"{type(e).__name__}: {e}"}
 
+    # -- serve-fleet stage: routed failover under a mid-run kill (ISSUE 19) --
+    try:
+        serve_fleet = bench_serve_fleet(config)
+        # acceptance: serve_fleet_rehome_parity == 1.0 (carry-shadow
+        # re-home resumes bit-exact) and serve_fleet_complete == 1.0
+        # (every request answered despite the kill); the blackout p99 is
+        # the client-visible failover stall
+        stages["serve_fleet_actions_per_sec"] = serve_fleet.get(
+            "actions_per_sec", 0.0
+        )
+        stages["serve_fleet_blackout_p99_ms"] = serve_fleet.get(
+            "blackout_p99_ms", 0.0
+        )
+        stages["serve_fleet_complete"] = serve_fleet.get("complete", 0.0)
+        stages["serve_fleet_rehome_parity"] = serve_fleet.get(
+            "rehome_parity_ok", 0.0
+        )
+    except Exception as e:
+        serve_fleet = {"error": f"{type(e).__name__}: {e}"}
+
     # Host/device fingerprint (ISSUE 15): stamped into every BENCH record
     # so scripts/bench_trajectory.py can tell which cross-record numbers
     # are comparable — absolute frames/sec only between like hosts,
@@ -1659,6 +1814,7 @@ def main() -> None:
                 "multichip": multichip,
                 "fused_multichip": fused_multichip,
                 "serve": serve,
+                "serve_fleet": serve_fleet,
                 "telemetry_jsonl": telemetry_path,
             }
         )

@@ -449,6 +449,32 @@ class ServeConfig:
     # Base seed of the serve-side sampling RNG stream: dispatch i samples
     # with fold_in(key(seed), i) — the stream the parity digest replays.
     seed: int = 0
+    # -- serve-fleet failover (ISSUE 19) ---------------------------------
+    # Per-request deadline budget in seconds: every ServeClient.step()
+    # resolves to an action or a typed ServeDeadlineError within this
+    # budget — reconnects, router redirects, and retries all spend from
+    # it. A dead backend is a bounded deadline miss, never a hang.
+    request_deadline_s: float = 10.0
+    # Bounded resend attempts per request inside the deadline budget (the
+    # actor-contract retry discipline: backoff between attempts, SIGTERM
+    # honored within one segment via should_abort).
+    request_retries: int = 4
+    # Router→backend liveness probe cadence: one persistent probe
+    # connection per backend (it holds one carry slot), heartbeat frames
+    # at this interval — a SIGKILL'd backend surfaces as EOF within one
+    # probe turn.
+    router_probe_s: float = 1.0
+    # Grace window before a probe-lost backend is declared DEAD and its
+    # sessions re-home (a transient reconnect inside the window is not a
+    # death). Keep > one probe turn to ride out GC/compile pauses.
+    router_dead_after_s: float = 3.0
+    # Opt-in carry-shadow mode: replies carry the updated recurrent carry
+    # row back to the client (narrowed by request_wire_dtype like every
+    # other leaf — bit-exact at the default f32 wire), and a re-homed
+    # session resends its stashed row so it resumes bit-exact on the new
+    # backend. Off: a re-home resets the carry to zeros (the
+    # reset_recurrent discipline) and is counted.
+    carry_shadow: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

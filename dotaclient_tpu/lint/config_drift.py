@@ -56,6 +56,7 @@ ALL_CLIS = OPERATOR_CLIS + (
     "dotaclient_tpu/league/__main__.py",
     "dotaclient_tpu/lint/__main__.py",
     "dotaclient_tpu/serve/__main__.py",
+    "dotaclient_tpu/serve/router.py",
     "scripts/serve_loadgen.py",
     "scripts/chaos_run.py",
     "scripts/fleet_status.py",

@@ -92,6 +92,10 @@ DYNAMIC_KEY_EXPANSIONS: Dict[Tuple[str, str], Tuple[str, ...]] = {
     ("fleet/", ""): (
         "a0/actor/env_steps", "a0/env_fps",
     ),
+    # serve/router.py per-backend session gauges (ISSUE 19): backend
+    # indices are runtime values — representative members; documented as
+    # the `router/backend/<i>/sessions` wildcard row
+    ("router/backend/", "/sessions"): ("0", "1"),
     # Outcome attribution plane (ISSUE 15; dotaclient_tpu/outcome/).
     # Keep the value tuples in sync with outcome.records BUCKETS / SIDES
     # / REWARD_TERMS / N_LEN_BUCKETS and the OUTCOME_KEYS schema tier.
@@ -133,8 +137,8 @@ _DOC_KEY_RE = re.compile(
 KEY_PREFIXES = (
     "actor/", "advantage/", "alerts/", "buffer/", "checkpoint/",
     "compile/", "faults/", "fleet/", "fused/", "health/", "league/",
-    "learner/", "mem/", "mesh/", "outcome/", "serve/", "shm/",
-    "snapshot/", "span/", "trace/", "transport/", "util/",
+    "learner/", "mem/", "mesh/", "outcome/", "router/", "serve/",
+    "shm/", "snapshot/", "span/", "trace/", "transport/", "util/",
 )
 # single-line inline code only: multi-line matches would mispair across
 # ``` fence lines (odd backtick count flips pairing for the whole doc)

@@ -9,7 +9,11 @@ builds the inference-only param tree from training checkpoints or
 published weights frames. See docs/ARCHITECTURE.md "Policy serving plane".
 """
 
-from dotaclient_tpu.serve.client import ServeClient, serve_request_wire_kwargs
+from dotaclient_tpu.serve.client import (
+    ServeClient,
+    ServeDeadlineError,
+    serve_request_wire_kwargs,
+)
 from dotaclient_tpu.serve.engine import ServeEngine
 from dotaclient_tpu.serve.policy_path import (
     load_inference_params,
@@ -17,14 +21,18 @@ from dotaclient_tpu.serve.policy_path import (
     slice_train_params,
     weights_frame_to_params,
 )
+from dotaclient_tpu.serve.router import SessionRouter, route_call
 from dotaclient_tpu.serve.server import PolicyServer
 
 __all__ = [
     "PolicyServer",
     "ServeClient",
+    "ServeDeadlineError",
     "ServeEngine",
+    "SessionRouter",
     "load_inference_params",
     "make_inference_policy",
+    "route_call",
     "serve_request_wire_kwargs",
     "slice_train_params",
     "weights_frame_to_params",

@@ -108,6 +108,15 @@ def main(argv=None) -> int:
         f"{config.serve.max_slots} carry slots, weights v{version})",
         flush=True,
     )
+    # machine-readable address line: the chaos harness and fleet tooling
+    # spawn ephemeral-port backends and parse this (ISSUE 19)
+    print(
+        "SERVE_LISTENING "
+        + json.dumps({
+            "host": server.address[0], "port": int(server.address[1]),
+        }),
+        flush=True,
+    )
 
     if args.subscribe:
         if args.subscribe.startswith("shm://"):

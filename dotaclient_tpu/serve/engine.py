@@ -62,6 +62,8 @@ logger = logging.getLogger(__name__)
 # reply callback: (packed_actions [5] int32, logp, weights_version,
 # request_id, dispatch_index). Must never block for long — it runs on the
 # batcher thread (socket replies enqueue to a per-connection writer).
+# Carry-shadow engines (ISSUE 19) additionally pass carry=<wire dict> by
+# keyword; default-mode callbacks never see the kwarg.
 ReplyFn = Callable[[np.ndarray, float, int, int, int], None]
 
 
@@ -130,6 +132,22 @@ class ServeEngine:
         self._stopped = False
         self._weights_lock = threading.Lock()
         self._pending_weights: Optional[Tuple[int, Any]] = None
+        # Carry-shadow plane (ISSUE 19): when enabled, every reply also
+        # hands the requester its updated carry ROW (host numpy), and a
+        # re-homed client resends that row so its session resumes
+        # bit-exact on a fresh backend. Inbound rows park here (slot →
+        # host row tree) and the batcher installs them BETWEEN dispatches
+        # — the same marshalling discipline as slot zeroes.
+        self._carry_shadow = bool(scfg.carry_shadow)
+        self._install_carries: Dict[int, Any] = {}
+        # one carry ROW's pytree shape: the wire flatten/unflatten template
+        # (leaves keyed c0..cN in jax.tree order)
+        row_template = policy.initial_state(1)
+        self._carry_row_treedef = jax.tree_util.tree_structure(row_template)
+        self._carry_row_shapes = [
+            np.asarray(leaf).shape[1:]
+            for leaf in jax.tree_util.tree_leaves(row_template)
+        ]
 
         def _dispatch_impl(params, obs, slots, reset, carries, rng):
             carry = jax.tree.map(lambda c: c[slots], carries)   # [B, ...]
@@ -145,7 +163,10 @@ class ServeEngine:
             new_carries = jax.tree.map(
                 lambda store, new: store.at[slots].set(new), carries, carry2
             )
-            return packed, logp.astype(jnp.float32), new_carries
+            # carry2 (the batch's per-row NEW carries) is returned for the
+            # shadow plane; the host fetch is gated on the knob, so the
+            # default path never pays the transfer
+            return packed, logp.astype(jnp.float32), new_carries, carry2
 
         # carries donated: the store updates in place in HBM every dispatch.
         # instrument_jit (ISSUE 12): serve recompiles are latency cliffs —
@@ -167,6 +188,20 @@ class ServeEngine:
 
         self._zero_slots_fn = jax.jit(_zero_slots_impl, donate_argnums=(0,))
 
+        def _install_carry_impl(carries, slot, row):
+            # row leaves arrive [1, ...] (a one-row tree); cast to the
+            # store dtype so a narrowed wire row still installs
+            return jax.tree.map(
+                lambda c, r: c.at[slot].set(
+                    jnp.reshape(r, c.shape[1:]).astype(c.dtype)
+                ),
+                carries, row,
+            )
+
+        self._install_carry_fn = jax.jit(
+            _install_carry_impl, donate_argnums=(0,)
+        )
+
         # eager-create: a serve run that never falls into a state still
         # reports zeros (check_telemetry_schema.py --require-serve)
         for name in (
@@ -178,6 +213,7 @@ class ServeEngine:
             "serve/max_batch_hits",
             "serve/weight_swaps_total",
             "serve/dispatch_errors_total",
+            "serve/carry_installs_total",
         ):
             self._tel.counter(name)
         self._tel.gauge("serve/batch_fill")
@@ -234,17 +270,31 @@ class ServeEngine:
         reset: bool,
         reply: ReplyFn,
         request_id: int = 0,
+        carry: Optional[Dict[str, np.ndarray]] = None,
     ) -> None:
         """Queue one game's step request. ``obs`` is a single observation
         (unbatched leaves matching the staging-lane template; validated
         here, on the caller's thread); ``reset`` marks the first step of
         an episode (the slot's carry row is zeroed before the core — the
-        actor-side episode-boundary discipline)."""
+        actor-side episode-boundary discipline). ``carry`` is a re-homed
+        session's shadowed row (the wire dict of :meth:`carry_row_to_wire`)
+        — installed into the slot by the batcher BEFORE this request
+        dispatches, so the session resumes where its dead backend left
+        off. Rejected when carry_shadow is off (an unexpected carry is a
+        protocol skew, and the poison discipline should see it)."""
         if not 0 <= slot < self._scfg.max_slots:
             raise ValueError(
                 f"slot {slot} out of range [0, {self._scfg.max_slots})"
             )
         self._validate_obs(obs)
+        row = None
+        if carry is not None:
+            if not self._carry_shadow:
+                raise ValueError(
+                    "request carries a shadow row but serve.carry_shadow "
+                    "is off on this backend (fleet config skew)"
+                )
+            row = self.wire_to_carry_row(carry)
         req = _Request(
             slot=slot,
             obs=obs,
@@ -256,6 +306,10 @@ class ServeEngine:
         with self._cond:
             if self._stopped:
                 raise RuntimeError("serve engine is stopped")
+            if row is not None:
+                # latest-wins per slot; ordered before the request it
+                # rode in on (installs drain before the next window)
+                self._install_carries[slot] = row
             self._pending.append(req)
             self._cond.notify()
         self._tel.counter("serve/requests_total").inc()
@@ -329,10 +383,20 @@ class ServeEngine:
                     return
                 resets = list(self._reset_slots)
                 self._reset_slots.clear()
+                installs = list(self._install_carries.items())
+                self._install_carries.clear()
             if resets:
                 self._carries = self._zero_slots_fn(
                     self._carries, np.asarray(resets, np.int32)
                 )
+            for slot, row in installs:
+                # after zeroes (a reclaimed slot re-attached with a shadow
+                # row must keep the row), before the window that carries
+                # the re-homed request
+                self._carries = self._install_carry_fn(
+                    self._carries, np.int32(slot), row
+                )
+                self._tel.counter("serve/carry_installs_total").inc()
             self._apply_pending_weights()
             rows = self._collect_window()
             if rows:
@@ -425,13 +489,18 @@ class ServeEngine:
         rng = jax.random.fold_in(self._rng0, self._dispatch_idx)
         t_d = time.perf_counter()
         with self._tel.span("serve/dispatch"):
-            packed, logp, self._carries = self._dispatch_fn(
+            packed, logp, self._carries, carry2 = self._dispatch_fn(
                 self._params, lanes, self._slots_np, self._reset_np,
                 self._carries, rng,
             )
             # the serving plane's one sync: replies need host actions
             packed_np = np.asarray(packed)   # host-sync-ok: serve batcher thread — replies leave the process here
             logp_np = np.asarray(logp)       # host-sync-ok: serve batcher thread
+            carry2_np = (
+                jax.tree.map(np.asarray, carry2)   # host-sync-ok: serve batcher thread — shadow rows ride the replies
+                if self._carry_shadow
+                else None
+            )
         idx = self._dispatch_idx
         self._dispatch_idx += 1
         version = self._version
@@ -443,10 +512,19 @@ class ServeEngine:
         for i, req in enumerate(rows):
             timer.observe(t_done - req.t0)
             try:
-                req.reply(
-                    packed_np[i], float(logp_np[i]), version,
-                    req.request_id, idx,
-                )
+                if carry2_np is None:
+                    req.reply(
+                        packed_np[i], float(logp_np[i]), version,
+                        req.request_id, idx,
+                    )
+                else:
+                    req.reply(
+                        packed_np[i], float(logp_np[i]), version,
+                        req.request_id, idx,
+                        carry=self.carry_row_to_wire(
+                            jax.tree.map(lambda c: c[i], carry2_np)
+                        ),
+                    )
             except Exception:   # noqa: BLE001 - a dead client must not kill the batcher
                 errors += 1
         if self._util is not None:
@@ -490,8 +568,46 @@ class ServeEngine:
             reset_np[i] = resets[i]
         rng = jax.random.fold_in(self._rng0, dispatch_idx)
         # donated carries: callers thread the returned tree back in
-        packed, logp, carries = self._dispatch_fn(
+        packed, logp, carries, _carry2 = self._dispatch_fn(
             self._params if params is None else jax.device_put(params),
             lanes, slots_np, reset_np, carries, rng,
         )
         return np.asarray(packed), np.asarray(logp), carries   # host-sync-ok: parity probe, off the serving path
+
+    # -- carry-shadow wire form ----------------------------------------------
+
+    def carry_row_to_wire(self, row: Any) -> Dict[str, np.ndarray]:
+        """One carry row tree → the flat wire dict replies ship
+        (``{"c0": leaf, ...}`` in ``jax.tree`` leaf order). The treedef
+        stays server-side; clients stash and resend the dict opaquely."""
+        return {
+            f"c{i}": np.asarray(leaf)
+            for i, leaf in enumerate(jax.tree_util.tree_leaves(row))
+        }
+
+    def wire_to_carry_row(self, wire: Dict[str, np.ndarray]) -> Any:
+        """Inverse of :meth:`carry_row_to_wire`, validated on the
+        SUBMITTING thread (bad structure raises → the wire's poison
+        discipline counts it, the batcher never sees it)."""
+        n = len(self._carry_row_shapes)
+        leaves = []
+        for i, shape in enumerate(self._carry_row_shapes):
+            leaf = wire.get(f"c{i}")
+            if leaf is None:
+                raise ValueError(
+                    f"shadow carry missing leaf c{i} (expected {n})"
+                )
+            arr = np.asarray(leaf)
+            if int(np.prod(arr.shape, dtype=np.int64)) != int(
+                np.prod(shape, dtype=np.int64)
+            ):
+                raise ValueError(
+                    f"shadow carry leaf c{i} has shape {arr.shape} — "
+                    f"incompatible with the carry row {shape}"
+                )
+            leaves.append(arr.reshape(shape))
+        if len(wire) != n:
+            raise ValueError(
+                f"shadow carry has {len(wire)} leaves, expected {n}"
+            )
+        return jax.tree_util.tree_unflatten(self._carry_row_treedef, leaves)

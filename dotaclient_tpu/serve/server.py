@@ -72,16 +72,27 @@ ATTACH_REQUEST_ID = 0
 def encode_reply(
     actions: np.ndarray, logp: float, version: int, slot: int,
     request_id: int, trace: "bytes | None" = None,
+    dispatch_idx: int = 0, carry: "dict | None" = None,
 ) -> Any:
     """One reply's wire bytes: packed head indices + joint logp, version
     in ``model_version``, slot in ``env_id``, echoed request id. A traced
     request's record (recv/reply hops appended server-side) rides back
-    in-band (ISSUE 12) so the client can close the round trip."""
+    in-band (ISSUE 12) so the client can close the round trip.
+
+    ``dispatch_idx`` names the dispatch (and hence the sampling rng
+    ``fold_in`` index) that produced this reply — the re-home parity
+    digest (ISSUE 19) replays exactly these indices. ``carry`` is the
+    carry-shadow row dict (``ServeEngine.carry_row_to_wire``), present
+    only on shadow-mode engines."""
+    arrays = {
+        "actions": np.asarray(actions, np.int32),
+        "logp": np.asarray(logp, np.float32),
+        "dispatch_idx": np.asarray(dispatch_idx, np.int32),
+    }
+    if carry is not None:
+        arrays["carry"] = carry
     return encode_rollout_bytes(
-        {
-            "actions": np.asarray(actions, np.int32),
-            "logp": np.asarray(logp, np.float32),
-        },
+        arrays,
         model_version=version,
         env_id=slot,
         rollout_id=request_id,
@@ -103,8 +114,9 @@ class _ServeConn:
         self.sock = sock
         self.slot = slot
         self.cond = threading.Condition()
-        # (actions, logp, version, request_id) tuples; encode happens on
-        # the writer thread so the batcher's reply callback stays O(1)
+        # (actions, logp, version, request_id, dispatch_idx, carry)
+        # tuples; encode happens on the writer thread so the batcher's
+        # reply callback stays O(1)
         self.replies: Deque[Tuple] = deque()
         self.dead = False
         self.bad_streak = 0
@@ -180,7 +192,7 @@ class PolicyServer:
             with conn.cond:
                 conn.replies.append(
                     (np.zeros((len(HEADS),), np.int32), 0.0,
-                     self._engine.version, ATTACH_REQUEST_ID)
+                     self._engine.version, ATTACH_REQUEST_ID, 0, None)
                 )
                 conn.cond.notify()
             threading.Thread(
@@ -241,15 +253,17 @@ class PolicyServer:
                     reset = bool(
                         np.asarray(arrays["reset"]).reshape(-1)[0]
                     )
-                    # submit validates the obs tree against the staging
-                    # lanes on THIS thread — a decodable request from a
+                    # submit validates the obs tree (and any re-homed
+                    # session's shadow carry row) against the engine on
+                    # THIS thread — a decodable request from a
                     # config-skewed client (wrong max_units, missing
-                    # leaf) rides the poison path below, and the batcher
-                    # never sees an undispatable row
+                    # leaf, alien carry) rides the poison path below,
+                    # and the batcher never sees an undispatable row
                     self._engine.submit(
                         conn.slot, obs, reset,
                         reply=self._make_reply(conn),
                         request_id=meta["rollout_id"],
+                        carry=arrays.get("carry"),
                     )
                 except Exception:
                     # undecodable or lane-incompatible request
@@ -264,11 +278,15 @@ class PolicyServer:
             self._drop(conn)
 
     def _make_reply(self, conn: _ServeConn):
-        def reply(actions, logp, version, request_id, dispatch_idx):
+        def reply(actions, logp, version, request_id, dispatch_idx,
+                  carry=None):
             with conn.cond:
                 if conn.dead:
                     raise ConnectionError("serve client gone")
-                conn.replies.append((actions, logp, version, request_id))
+                conn.replies.append(
+                    (actions, logp, version, request_id, dispatch_idx,
+                     carry)
+                )
                 conn.cond.notify()
 
         return reply
@@ -284,11 +302,11 @@ class PolicyServer:
                 conn.replies.clear()
                 reply_traces = {
                     rid: conn.traces.pop(rid)
-                    for _a, _l, _v, rid in batch
+                    for _a, _l, _v, rid, _d, _c in batch
                     if rid in conn.traces
                 } if conn.traces else {}
             try:
-                for actions, logp, version, request_id in batch:
+                for actions, logp, version, request_id, didx, carry in batch:
                     blob = None
                     rec = reply_traces.get(request_id)
                     if rec is not None:
@@ -298,7 +316,7 @@ class PolicyServer:
                         conn.sock, KIND_SERVE_REPLY,
                         encode_reply(
                             actions, logp, version, conn.slot, request_id,
-                            trace=blob,
+                            trace=blob, dispatch_idx=didx, carry=carry,
                         ),
                     )
             except (OSError, ValueError):

@@ -222,6 +222,28 @@ RULES: Tuple[AlertRule, ...] = (
         runbook="rb:throughput-regression",
         summary="learner steps/s EMA regressed below 0.7x its baseline",
     ),
+    # -- serve-fleet failover (ISSUE 19; dotaclient_tpu/serve/router.py) -
+    AlertRule(
+        # the router's probe plane declares a backend DEAD only after the
+        # router_dead_after_s grace window of failed reconnects — this
+        # gauge is zero in every healthy fleet, so any nonzero value is a
+        # page. Rules with no data are skipped, so learner registries
+        # (no router/ keys) never evaluate it.
+        "serve_peer_dead", key="router/backends_dead",
+        kind="threshold", op=">", value=0.0, for_s=0.0, severity="page",
+        runbook="rb:serve-peer-dead",
+        summary="a serve backend is dead past the probe grace window",
+    ),
+    AlertRule(
+        # every re-home is a state discontinuity for a live game (carry
+        # reset, or a shadow-row transfer) — a nonzero rate means the
+        # fleet is actively failing over and capacity planning should
+        # hear about it even after the page resolves
+        "sessions_rehomed_burst", key="router/sessions_rehomed_total",
+        kind="rate", value=0.0, window_s=60.0, severity="warn",
+        runbook="rb:sessions-rehomed",
+        summary="sessions re-homing off dead serve backends",
+    ),
 )
 
 

@@ -59,6 +59,7 @@ __all__ = [
     "FleetAggregator",
     "encode_snapshot",
     "decode_snapshot",
+    "peer_label",
     "configure",
     "get",
     "shutdown",
@@ -82,6 +83,15 @@ SNAPSHOT_PREFIXES = (
 # instead of leaking a new one — and the fleet_peer_stale page resolves
 # on the fresh incarnation's first snapshot.
 PEER_KINDS = ("actor", "serve")
+
+
+def peer_label(kind: str, peer_id: int) -> str:
+    """The ONE derivation of a fleet peer's stable label
+    (``<kind initial><peer id>``: ``a0``, ``s7788``). Serve peers key on
+    their listen port, so the serve-fleet router (ISSUE 19) can name a
+    backend's fleet row — ``fleet/<label>/serve/p99_latency_ms`` — from
+    nothing but the address it routes to."""
+    return f"{kind[0]}{int(peer_id)}"
 
 # Fleet rollups: metric name → (source kind, peer-side key). "gauge" =
 # last value per peer, "counter" = delta-merged total per peer, "rate" =
@@ -194,7 +204,7 @@ def decode_snapshot(payload: Any) -> Optional[Dict[str, Any]]:
             else "actor"
         )
         return {
-            "peer": f"{kind[0]}{int(meta['env_id'])}",
+            "peer": peer_label(kind, int(meta["env_id"])),
             "kind": kind,
             "pid": int(meta["model_version"]),
             "seq": int(meta["rollout_id"]),

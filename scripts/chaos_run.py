@@ -84,6 +84,9 @@ class Supervisor:
         self.ckpt_dir = os.path.join(self.workdir, "ckpt")
         self.actors: List[Optional[subprocess.Popen]] = [None] * args.actors
         self.learner: Optional[subprocess.Popen] = None
+        # serve_failover scenario children (backends/router/loadgen):
+        # tracked for cleanup only — no restart policy applies to them
+        self.serve_children: List[subprocess.Popen] = []
         self.actor_extra: List[str] = []   # per-scenario extra actor flags
         self.actor_restarts = 0
         self.actor_kills = 0
@@ -791,11 +794,283 @@ class Supervisor:
             )
         return summary
 
+    # -- serve failover scenario (ISSUE 19) ---------------------------------
+
+    def _spawn_child(self, name: str, cmd: List[str]) -> subprocess.Popen:
+        """A serve-plane child (backend / router / loadgen): CPU-pinned,
+        fault-free env, log at ``<name>.log``."""
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)      # see _spawn_learner
+        env.pop("DOTA_FAULTS", None)
+        log = open(os.path.join(self.workdir, f"{name}.log"), "w")
+        proc = subprocess.Popen(
+            cmd, cwd=REPO, env=env, stdout=log, stderr=subprocess.STDOUT
+        )
+        self.serve_children.append(proc)
+        return proc
+
+    def _wait_banner(
+        self, proc: subprocess.Popen, log_path: str, tag: str
+    ) -> Dict:
+        """Poll a child's log for its machine-readable ``TAG {json}``
+        startup line (SERVE_LISTENING / ROUTER_LISTENING)."""
+        while True:
+            self._check_deadline()
+            try:
+                with open(log_path) as f:
+                    for line in f:
+                        if line.startswith(tag + " "):
+                            return json.loads(line[len(tag) + 1:])
+            except (OSError, json.JSONDecodeError):
+                pass
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"child exited rc={proc.returncode} before printing "
+                    f"{tag} — see {log_path}"
+                )
+            time.sleep(0.2)
+
+    def run_serve_failover(self) -> Dict:
+        """ISSUE 19 acceptance scenario — serve-fleet failover under
+        chaos. Two real serve backends + one hot spare (identical
+        processes off one tiny checkpoint; spare-ness is a router-side
+        designation) behind a standalone ``SessionRouter``; a loadgen
+        fleet of live games attaches through the router and steps at a
+        game cadence. Mid-game, one backend is SIGKILLed and HELD DOWN:
+        the router's probe declares it dead past the grace window, the
+        ``serve_peer_dead`` alert PAGES with its runbook anchor, the
+        spare is promoted and every stranded session re-homes — and the
+        loadgen must still complete EVERY game with zero errors (bounded
+        deadlines, never a hang). The carry half of the contract is
+        pinned in-process afterwards: the re-home parity digest
+        (carry-shadow mode) must be bitwise."""
+        a = self.args
+        summary: Dict = {"scenario": "serve_failover", "seed": a.seed}
+        # no learner/actor topology in this scenario: disarm the actor
+        # restart policy (_wait_exit tends actors between polls)
+        self.shutting_down = True
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+        # 1) one tiny REAL checkpoint all three backends load (the stored
+        # config carries the tiny model dims; the obs/action spec stays
+        # the default the loadgen clients derive their requests from)
+        import dataclasses as _dc
+
+        import jax
+
+        from dotaclient_tpu.config import ModelConfig, RunConfig
+        from dotaclient_tpu.models import make_policy
+        from dotaclient_tpu.models.policy import init_params
+        from dotaclient_tpu.train.ppo import init_train_state
+        from dotaclient_tpu.utils.checkpoint import CheckpointManager
+
+        cfg = RunConfig()
+        cfg = _dc.replace(cfg, model=ModelConfig(
+            unit_embed_dim=8, hidden_dim=8, hero_embed_dim=4,
+        ))
+        policy = make_policy(cfg.model, cfg.obs, cfg.actions)
+        params = init_params(policy, jax.random.PRNGKey(a.seed))
+        mgr = CheckpointManager(self.ckpt_dir)
+        assert mgr.save(init_train_state(params, cfg.ppo), cfg, force=True)
+        mgr.close()
+
+        # 2) the fleet: three backends, then the router over them
+        backends = [
+            self._spawn_child(f"serve{i}", [
+                sys.executable, "-m", "dotaclient_tpu.serve",
+                "--checkpoint", self.ckpt_dir,
+                "--serve-listen", "127.0.0.1:0",
+            ])
+            for i in range(3)
+        ]
+        addrs = [
+            self._wait_banner(
+                backends[i],
+                os.path.join(self.workdir, f"serve{i}.log"),
+                "SERVE_LISTENING",
+            )
+            for i in range(3)
+        ]
+
+        def addr_str(d: Dict) -> str:
+            return f"{d['host']}:{d['port']}"
+
+        router_jsonl = os.path.join(self.workdir, "router.jsonl")
+        router_proc = self._spawn_child("router", [
+            sys.executable, "-m", "dotaclient_tpu.serve.router",
+            "--listen", "127.0.0.1:0",
+            "--backends", ",".join(addr_str(x) for x in addrs[:2]),
+            "--spares", addr_str(addrs[2]),
+            "--serve", "router_probe_s=0.2,router_dead_after_s=1.0",
+            "--metrics-jsonl", router_jsonl,
+            "--interval", "0.5",
+        ])
+        rinfo = self._wait_banner(
+            router_proc, os.path.join(self.workdir, "router.log"),
+            "ROUTER_LISTENING",
+        )
+
+        def router_scalar(key: str) -> float:
+            # high-water mark over the stream: "assembled at least once"
+            # triggers, snapshot-cadence lag tolerated
+            best = 0.0
+            for rec in _jsonl_scalars(router_jsonl):
+                sc = rec.get("scalars")
+                if isinstance(sc, dict):
+                    best = max(best, sc.get(key) or 0.0)
+            return best
+
+        def wait_router(pred, what: str) -> None:
+            while not pred():
+                self._check_deadline()
+                if router_proc.poll() is not None:
+                    raise RuntimeError(
+                        f"router exited rc={router_proc.returncode} "
+                        f"while waiting for {what}"
+                    )
+                time.sleep(0.3)
+
+        wait_router(
+            lambda: router_scalar("router/backends_live") >= 2
+            and router_scalar("router/spares_available") >= 1,
+            "the probes to confirm 2 live backends + 1 spare",
+        )
+
+        # 3) live games through the router; generous per-request failover
+        # budget so a mid-blackout request re-homes instead of missing
+        # its deadline — the gate is zero errors, not zero disruption
+        load_proc = self._spawn_child("loadgen", [
+            sys.executable,
+            os.path.join(REPO, "scripts", "serve_loadgen.py"),
+            "--addr", addr_str(rinfo), "--router",
+            "--clients", str(a.serve_clients),
+            "--requests", str(a.serve_requests),
+            "--think-ms", "20",
+            "--max-reconnects", "10",
+            "--serve", "request_deadline_s=30,request_retries=20",
+            "--seed", str(a.seed),
+        ])
+        wait_router(
+            lambda: router_scalar("router/sessions_active")
+            >= a.serve_clients,
+            "every game to attach",
+        )
+        if load_proc.poll() is not None:
+            summary["fail"] = "loadgen finished before the kill landed"
+            return summary
+
+        # 4) SIGKILL one active backend and HOLD it down — no restart.
+        # Its sessions are mid-game; the router must move them.
+        backends[0].kill()
+        summary["killed_backend"] = addr_str(addrs[0])
+        t_kill = time.time()
+
+        def wait_alert(rule: str, state: str) -> Dict:
+            while True:
+                self._check_deadline()
+                for ev in self._alert_events(router_jsonl):
+                    if ev.get("rule") == rule and ev.get("state") == state:
+                        return ev
+                if router_proc.poll() is not None:
+                    raise RuntimeError(
+                        f"router exited rc={router_proc.returncode} "
+                        f"before the {rule!r} alert reached {state!r}"
+                    )
+                time.sleep(0.3)
+
+        try:
+            fired = wait_alert("serve_peer_dead", "fired")
+        except (TimeoutError, RuntimeError) as e:
+            summary["fail"] = f"serve_peer_dead never fired: {e}"
+            return summary
+        summary["dead_alert_fired"] = {
+            "runbook": fired.get("runbook"),
+            "severity": fired.get("severity"),
+            "after_s": round(fired.get("ts", t_kill) - t_kill, 1),
+        }
+
+        # 5) every game must complete — re-homed ones included
+        rc = self._wait_exit(load_proc, "serve loadgen")
+        summary["loadgen_exit"] = rc
+        loadgen_out: Dict = {}
+        for rec in _jsonl_scalars(os.path.join(self.workdir, "loadgen.log")):
+            if "replies" in rec:
+                loadgen_out = rec
+        summary["loadgen"] = {
+            k: loadgen_out.get(k)
+            for k in (
+                "replies", "errors", "error_sample", "deadline_errors",
+                "sessions_rehomed", "actions_per_sec", "p99_ms",
+            )
+        }
+
+        # 6) drain the survivors: SIGINT → final summaries, clean exits
+        for proc in (router_proc, backends[1], backends[2]):
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGINT)
+        summary["router_exit"] = self._wait_exit(router_proc, "router")
+        summary["backend_exits"] = [
+            self._wait_exit(backends[i], f"serve{i}") for i in (1, 2)
+        ]
+        summary["router_sessions_rehomed"] = router_scalar(
+            "router/sessions_rehomed_total"
+        )
+        summary["router_spares_promoted"] = router_scalar(
+            "router/spares_promoted_total"
+        )
+        summary["router_backend_deaths"] = router_scalar(
+            "router/backend_deaths_total"
+        )
+
+        # 7) the carry half of the re-home contract: bit-exact resume
+        # under carry-shadow, pinned in-process against reference_step
+        from scripts.serve_loadgen import run_rehome_parity
+
+        digest = run_rehome_parity(seed=a.seed)
+        summary["rehome_parity"] = digest
+
+        expected = a.serve_clients * a.serve_requests
+        if rc != 0 or loadgen_out.get("errors", 1):
+            summary["fail"] = (
+                "a game failed or hung through the failover: loadgen "
+                f"rc={rc}, errors={loadgen_out.get('errors')} "
+                f"({loadgen_out.get('error_sample')})"
+            )
+        elif loadgen_out.get("replies") != expected:
+            summary["fail"] = (
+                f"stranded sessions: {loadgen_out.get('replies')} of "
+                f"{expected} requests answered"
+            )
+        elif loadgen_out.get("sessions_rehomed", 0) < 1:
+            summary["fail"] = (
+                "no session re-homed — the kill landed after the games "
+                "finished (widen --serve-requests)"
+            )
+        elif summary["dead_alert_fired"]["runbook"] != "rb:serve-peer-dead":
+            summary["fail"] = (
+                f"death alert carries the wrong runbook anchor: "
+                f"{summary['dead_alert_fired']['runbook']!r}"
+            )
+        elif summary["router_spares_promoted"] < 1:
+            summary["fail"] = "the hot spare was never promoted"
+        elif summary["router_exit"] != 0 or any(summary["backend_exits"]):
+            summary["fail"] = (
+                "a surviving serve child did not drain cleanly: router "
+                f"rc={summary['router_exit']}, backends "
+                f"{summary['backend_exits']}"
+            )
+        elif digest.get("parity") != "bitwise":
+            summary["fail"] = (
+                f"re-home parity digest failed: {digest.get('parity')}"
+            )
+        return summary
+
     def cleanup(self) -> None:
         self.shutting_down = True
         # the learner too: a timed-out/failed plan must not orphan a live
         # learner holding the port and writing into the workdir
-        for p in (*self.actors, self.learner):
+        for p in (*self.actors, self.learner, *self.serve_children):
             if p is not None and p.poll() is None:
                 p.kill()
 
@@ -820,7 +1095,8 @@ def main(argv=None) -> int:
                    help="actor 0 corrupts its corrupt-at'th frame and "
                    "every corrupt-every'th after")
     p.add_argument("--scenario",
-                   choices=("baseline", "divergence", "alerts", "outcome"),
+                   choices=("baseline", "divergence", "alerts", "outcome",
+                            "serve_failover"),
                    default="baseline",
                    help="baseline: kill/corrupt/SIGTERM/restore plan "
                    "(ISSUE 4); divergence: injected NaN gradient → "
@@ -833,7 +1109,11 @@ def main(argv=None) -> int:
                    "the whole fleet is killed and held down → "
                    "outcome_stream_stale fires with its anchor → resolves "
                    "when the restarted fleet completes fresh episodes "
-                   "(ISSUE 15)")
+                   "(ISSUE 15); serve_failover: a serve backend is "
+                   "SIGKILLed and held down mid-game — serve_peer_dead "
+                   "pages, the hot spare promotes, every session re-homes "
+                   "inside its deadline budget, and the re-home parity "
+                   "digest stays bitwise (ISSUE 19)")
     p.add_argument("--fleet-interval", type=float, default=0.5,
                    help="alerts scenario: fleet snapshot/aggregation "
                    "cadence in seconds (fast, so staleness detection and "
@@ -849,6 +1129,13 @@ def main(argv=None) -> int:
                    help="divergence scenario: periodic checkpoint cadence "
                    "(tight, so a last_good restore point exists before "
                    "the NaN lands)")
+    p.add_argument("--serve-clients", type=int, default=6,
+                   help="serve_failover scenario: concurrent games in the "
+                   "loadgen fleet")
+    p.add_argument("--serve-requests", type=int, default=200,
+                   help="serve_failover scenario: requests per game (at a "
+                   "20 ms think cadence — long enough that the kill lands "
+                   "mid-game)")
     p.add_argument("--timeout", type=float, default=900.0)
     p.add_argument("--keep-workdir", action="store_true")
     args = p.parse_args(argv)
@@ -863,6 +1150,8 @@ def main(argv=None) -> int:
             summary = sup.run_alerts()
         elif args.scenario == "outcome":
             summary = sup.run_outcome()
+        elif args.scenario == "serve_failover":
+            summary = sup.run_serve_failover()
         else:
             summary = sup.run()
     except (TimeoutError, RuntimeError) as e:

@@ -202,6 +202,29 @@ SERVE_KEYS = (
     "serve/clients_connected",     # attached games
     "serve/slots_in_use",          # carry slots owned by live games
     "serve/conns_rejected_total",  # joiners shed with every slot taken
+    "serve/carry_installs_total",  # re-homed shadow rows installed (ISSUE 19)
+)
+
+# Serve-fleet router (ISSUE 19). Validated with --require-router against a
+# SessionRouter run's JSONL (`python -m dotaclient_tpu.serve.router
+# --metrics-jsonl PATH`): the router eager-creates every one of these at
+# construction, so a fleet that never lost a backend still deterministically
+# reports zeros. Per-backend keys (router/backend/<i>/sessions) are dynamic
+# and NOT in the tier.
+ROUTER_KEYS = (
+    "router/sessions_attached_total",   # sessions assigned a home
+    "router/sessions_detached_total",   # clean client detaches
+    "router/sessions_rehomed_total",    # sessions moved off dead backends
+    "router/carry_resets_total",        # client-reported default-mode resets
+    "router/spares_promoted_total",     # hot spares entered the pool
+    "router/backend_deaths_total",      # probes declared past the grace window
+    "router/probe_reconnects_total",    # probe redials (blips + deaths)
+    "router/route_requests_total",      # control ops served
+    "router/route_errors_total",        # malformed/unroutable control ops
+    "router/backends_live",             # live non-spare backends
+    "router/backends_dead",             # dead non-spare backends (page signal)
+    "router/spares_available",          # live unpromoted spares
+    "router/sessions_active",           # sessions currently mapped
 )
 
 # Pipeline tracing + device observability (ISSUE 12). Validated with
@@ -490,6 +513,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         "construction",
     )
     p.add_argument(
+        "--require-router", action="store_true",
+        help="also require the serve-fleet router keys (ISSUE 19); valid "
+        "against a SessionRouter run's JSONL (--metrics-jsonl) — the "
+        "router eager-creates every key at construction",
+    )
+    p.add_argument(
         "--require-trace", action="store_true",
         help="also require the pipeline-tracing + device-observability "
         "keys (ISSUE 12); valid against ANY learner run's JSONL — the "
@@ -543,6 +572,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         extra += HEALTH_KEYS
     if args.require_serve:
         extra += SERVE_KEYS
+    if args.require_router:
+        extra += ROUTER_KEYS
     if args.require_advantage:
         extra += ADVANTAGE_KEYS
     if args.require_multichip:
@@ -568,9 +599,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         lines = load_jsonl(path)
 
-    # a serve run is a different process class: its JSONL carries the
-    # serve-plane keys, not the learner pipeline's actor/buffer spans
-    base = () if args.require_serve else None
+    # serve and router runs are different process classes: their JSONLs
+    # carry their own plane's keys, not the learner's actor/buffer spans
+    base = () if args.require_serve or args.require_router else None
     errors = validate_lines(lines, extra_required=extra, base_required=base)
     if errors:
         print("telemetry schema check FAILED:", file=sys.stderr)

@@ -77,6 +77,23 @@ report bench_gate $?
 python scripts/run_multichip.py --fused-parity 2 --steps 2 --parity-steps 2
 report fused_parity $?
 
+# -- stage 6: router failover smoke (ISSUE 19) -----------------------------
+# In-process serve-fleet failover: three tiny backends, a session-affine
+# router, a mid-game backend kill — the re-home must land bit-exact
+# (parity digest "bitwise", exit 0 iff so) and the router's JSONL must
+# carry the eagerly-created router/* schema tier.
+ROUTER_JSONL="$(mktemp /tmp/ci_gate_router_XXXXXX.jsonl)"
+trap 'rm -f "$SMOKE_JSONL" "$ROUTER_JSONL"' EXIT
+python scripts/serve_loadgen.py --rehome-parity --metrics-jsonl "$ROUTER_JSONL"
+ROUTER_RC=$?
+if [ "$ROUTER_RC" -ne 0 ]; then
+    report router_failover "$ROUTER_RC"
+else
+    python scripts/check_telemetry_schema.py --path "$ROUTER_JSONL" \
+        --require-router
+    report router_failover $?
+fi
+
 echo "== ci_gate summary =="
 for line in "${SUMMARY[@]}"; do
     echo "  $line"

@@ -9,6 +9,9 @@ FleetAggregator's merged ``fleet/`` keys ride, plus the structured
   corrupt frames, plus the min/max/mean rollups;
 * the **utilization panel** (ISSUE 16): the learner's duty cycle, its
   top stall phases, and the throughput sentinel's state;
+* the **router panel** (ISSUE 19): serve-fleet liveness — backends
+  live/dead, spare pool, per-backend session counts, and the re-home /
+  promotion totals (drawn only when the stream carries ``router/*``);
 * the **alert board**: every alert currently active (fired, not yet
   resolved), with severity and its OPERATIONS.md runbook anchor;
 * a machine-readable ``FLEET_STATUS`` JSON line (the chaos harness and
@@ -239,6 +242,37 @@ def render(
         )
     else:
         lines.append("util: unarmed (no fold yet)")
+    # router panel (ISSUE 19): the serve-fleet routing plane — only drawn
+    # when the stream carries router/* keys (a SessionRouter's
+    # --metrics-jsonl, or a learner stream it was merged into)
+    has_router = any(k.startswith("router/") for k in scalars)
+    if has_router:
+        per_backend = sorted(
+            (k.split("/")[2], int(v))
+            for k, v in scalars.items()
+            if k.startswith("router/backend/") and k.endswith("/sessions")
+        )
+        lines.append(
+            f"router: backends {int(scalars.get('router/backends_live', 0))}"
+            f" live / {int(scalars.get('router/backends_dead', 0))} dead | "
+            f"spares {int(scalars.get('router/spares_available', 0))} | "
+            f"sessions {int(scalars.get('router/sessions_active', 0))} active"
+            + (
+                " (" + " ".join(f"b{i}={n}" for i, n in per_backend) + ")"
+                if per_backend
+                else ""
+            )
+        )
+        lines.append(
+            "        rehomed "
+            f"{int(scalars.get('router/sessions_rehomed_total', 0))} "
+            f"(carry_resets "
+            f"{int(scalars.get('router/carry_resets_total', 0))}) | "
+            f"promoted {int(scalars.get('router/spares_promoted_total', 0))} "
+            f"| deaths {int(scalars.get('router/backend_deaths_total', 0))} | "
+            f"probe_reconnects "
+            f"{int(scalars.get('router/probe_reconnects_total', 0))}"
+        )
     fired_total = scalars.get("alerts/fired_total", 0.0)
     lines.append(
         f"alerts: {len(actives)} active, {int(fired_total)} fired this run"
@@ -271,6 +305,30 @@ def render(
                 scalars.get("util/throughput_regression", 0.0)
             ),
         },
+        "router": (
+            {
+                "backends_live": int(scalars.get("router/backends_live", 0)),
+                "backends_dead": int(scalars.get("router/backends_dead", 0)),
+                "spares_available": int(
+                    scalars.get("router/spares_available", 0)
+                ),
+                "sessions_active": int(
+                    scalars.get("router/sessions_active", 0)
+                ),
+                "sessions_rehomed_total": int(
+                    scalars.get("router/sessions_rehomed_total", 0)
+                ),
+                "spares_promoted_total": int(
+                    scalars.get("router/spares_promoted_total", 0)
+                ),
+                "backend_deaths_total": int(
+                    scalars.get("router/backend_deaths_total", 0)
+                ),
+                "backend_sessions": dict(per_backend),
+            }
+            if has_router
+            else None
+        ),
         "peers": peers,
         "n_peers": int(n_live),
         "peers_stale": int(n_stale),

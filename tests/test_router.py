@@ -1,0 +1,510 @@
+"""Serve-fleet failover tests (ISSUE 19).
+
+Pins the fleet contracts: the client's bounded failure budget (deadline
+expiry mid-window, retry-then-succeed, retry-exhausted — all surfacing as
+the typed ``ServeDeadlineError``, never a hang), the router's control
+plane (attach/where/detach/status), death declaration with hot-spare
+promotion and session re-homing, the honest re-home state contract
+(default: explicit counted carry reset; carry-shadow: bit-exact resume,
+pinned by the parity digest), quarantine composing with the recovery path
+(slot reclaimed, fresh slot, NOT a re-home), and the ``--require-router``
+telemetry tier.
+
+The fast tests run against a wire-accurate fake backend (attach frame +
+scripted behaviors, no jit); the end-to-end failover paths ride real
+engines and are slow-marked.
+"""
+
+import dataclasses
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dotaclient_tpu.models.distributions import HEADS
+from dotaclient_tpu.serve import (
+    PolicyServer,
+    ServeClient,
+    ServeDeadlineError,
+    SessionRouter,
+    route_call,
+)
+from dotaclient_tpu.serve.server import (
+    ATTACH_REQUEST_ID,
+    KIND_SERVE_REPLY,
+    KIND_SERVE_REQUEST,
+    encode_reply,
+)
+from dotaclient_tpu.transport.socket_transport import (
+    FrameCorrupt,
+    FramingLost,
+    _recv_frame,
+    _send_frame,
+)
+from dotaclient_tpu.transport.serialize import decode_rollout_bytes
+from dotaclient_tpu.utils import telemetry
+from tests.test_serve import make_engine, one_obs, tiny_config, wait_until
+
+
+class FakeBackend:
+    """A wire-accurate, policy-free serve backend: accepts connections,
+    sends the attach frame, then applies one scripted behavior to request
+    frames. Heartbeat (probe) frames are read and ignored, so a
+    ``SessionRouter`` sees it as a live peer.
+
+    behaviors:
+      * ``"echo"``       — reply to every request (fixed action row)
+      * ``"blackhole"``  — read requests, never reply (a stuck window)
+      * ``"close_first"``— close the connection on the first N requests
+                           it ever sees, then echo (transient failure)
+    """
+
+    def __init__(self, behavior="echo", close_first=0):
+        self.behavior = behavior
+        self.close_remaining = [close_first]
+        self.requests_seen = [0]
+        self._lock = threading.Lock()
+        self._next_slot = [0]
+        self._conns = []
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(32)
+        self.address = self._listener.getsockname()
+        self._closed = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="fake-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    def _accept_loop(self):
+        while not self._closed.is_set():
+            try:
+                sock, _addr = self._listener.accept()
+            except OSError:
+                return
+            with self._lock:
+                self._conns.append(sock)
+                slot = self._next_slot[0]
+                self._next_slot[0] += 1
+            threading.Thread(
+                target=self._conn_loop, args=(sock, slot),
+                name="fake-conn", daemon=True,
+            ).start()
+
+    def _conn_loop(self, sock, slot):
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _send_frame(
+                sock,
+                KIND_SERVE_REPLY,
+                encode_reply(
+                    np.zeros((len(HEADS),), np.int32), 0.0, 1, slot,
+                    ATTACH_REQUEST_ID,
+                ),
+            )
+            while not self._closed.is_set():
+                frame = _recv_frame(sock)
+                if frame is None:
+                    return
+                kind, payload = frame
+                if kind != KIND_SERVE_REQUEST:
+                    continue  # probe heartbeats: read and ignore
+                with self._lock:
+                    self.requests_seen[0] += 1
+                    must_close = self.close_remaining[0] > 0
+                    if must_close:
+                        self.close_remaining[0] -= 1
+                if self.behavior == "blackhole":
+                    continue
+                if self.behavior == "close_first" and must_close:
+                    return
+                meta, _arrays = decode_rollout_bytes(
+                    bytes(payload), upcast=True
+                )
+                _send_frame(
+                    sock,
+                    KIND_SERVE_REPLY,
+                    encode_reply(
+                        np.array([1, 2, 3, 0, 4], np.int32), 0.25, 1,
+                        slot, meta["rollout_id"],
+                        dispatch_idx=self.requests_seen[0],
+                    ),
+                )
+        except (OSError, FrameCorrupt, FramingLost):
+            pass
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def close(self):
+        self._closed.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for sock in conns:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+# -- client failure budget (deadline/retry matrix, fake backend) -------------
+
+
+def test_deadline_expiry_mid_window_is_typed_and_bounded():
+    """A backend that accepts the request and never replies (the stuck-
+    window shape) must surface as ServeDeadlineError WITHIN the budget —
+    not a hang on the socket timeout."""
+    backend = FakeBackend("blackhole")
+    config = tiny_config(request_deadline_s=0.6, request_retries=2)
+    try:
+        client = ServeClient(*backend.address, config, timeout_s=5.0)
+        t0 = time.monotonic()
+        with pytest.raises(ServeDeadlineError):
+            client.step(one_obs(config), reset=True)
+        elapsed = time.monotonic() - t0
+        # budget + one bounded backoff segment of slack, nowhere near the
+        # 5 s socket timeout
+        assert elapsed < 3.0, f"deadline not honored: {elapsed:.1f}s"
+        client.close()
+    finally:
+        backend.close()
+
+
+def test_retry_then_succeed_counts_the_discontinuity():
+    """A transient connection drop rides the retry path transparently —
+    and the fresh slot's carry reset is explicit and counted, never
+    silent."""
+    backend = FakeBackend("close_first", close_first=1)
+    config = tiny_config(request_deadline_s=10.0, request_retries=4)
+    try:
+        client = ServeClient(*backend.address, config, timeout_s=5.0)
+        actions = client.step(one_obs(config), reset=True)
+        assert client.retries_total >= 1
+        assert actions["action_type"] == 1
+        assert np.array_equal(
+            client.last_packed, np.array([1, 2, 3, 0, 4], np.int32)
+        )
+        # the reconnect landed on a fresh slot: the restore path made the
+        # reset explicit (default mode) and counted it
+        assert client.carry_resets == 1
+        # no router in play: a plain reconnect is NOT a re-home
+        assert client.rehomed_count == 0
+        client.close()
+    finally:
+        backend.close()
+
+
+def test_retry_exhausted_raises_typed_error_with_bounded_attempts():
+    config = tiny_config(request_deadline_s=30.0, request_retries=1)
+    backend = FakeBackend("close_first", close_first=100)
+    try:
+        client = ServeClient(*backend.address, config, timeout_s=5.0)
+        with pytest.raises(ServeDeadlineError) as exc:
+            client.step(one_obs(config), reset=True)
+        # attempts = retries + 1, spelled out in the error
+        assert "2 attempt(s)" in str(exc.value)
+        assert client.retries_total == 2
+        client.close()
+    finally:
+        backend.close()
+
+
+# -- router control plane (fake backends) ------------------------------------
+
+
+def router_stack(n_backends=2, n_spares=0, **serve_over):
+    serve_over.setdefault("router_probe_s", 0.1)
+    serve_over.setdefault("router_dead_after_s", 0.4)
+    config = tiny_config(**serve_over)
+    backends = [
+        FakeBackend("echo") for _ in range(n_backends + n_spares)
+    ]
+    reg = telemetry.Registry()
+    router = SessionRouter(
+        config,
+        [b.address for b in backends[:n_backends]],
+        spares=[b.address for b in backends[n_backends:]],
+        registry=reg,
+    )
+    return config, backends, reg, router
+
+
+def _route(router, request):
+    sock = socket.create_connection(router.address, timeout=5.0)
+    try:
+        return route_call(sock, request, timeout=5.0)
+    finally:
+        sock.close()
+
+
+def test_router_attach_where_detach_status():
+    config, backends, reg, router = router_stack(n_backends=2)
+    try:
+        assert wait_until(
+            lambda: reg.snapshot().get("router/backends_live") == 2.0
+        )
+        a = _route(router, {"op": "attach"})
+        b = _route(router, {"op": "attach"})
+        assert a["session"] != b["session"]
+        addrs = {tuple(x.address) for x in backends}
+        assert (a["addr"][0], a["addr"][1]) in addrs
+        # least-loaded assignment spreads the two sessions
+        assert a["addr"] != b["addr"]
+        w = _route(router, {"op": "where", "session": a["session"]})
+        assert w["addr"] == a["addr"] and w["epoch"] == 0
+        assert not w["rehomed"]
+        status = _route(router, {"op": "status"})
+        assert len(status["backends"]) == 2
+        assert _route(
+            router, {"op": "detach", "session": a["session"]}
+        )["detached"]
+        assert not _route(
+            router, {"op": "detach", "session": a["session"]}
+        )["detached"]
+        assert _route(router, {"op": "nonsense"}).get("error")
+        snap = reg.snapshot()
+        assert snap["router/sessions_attached_total"] == 2.0
+        assert snap["router/sessions_detached_total"] == 1.0
+        assert snap["router/sessions_active"] == 1.0
+        assert snap["router/route_errors_total"] == 1.0
+    finally:
+        router.close()
+        for b in backends:
+            b.close()
+
+
+def test_router_death_promotes_spare_and_rehomes_sessions():
+    config, backends, reg, router = router_stack(n_backends=2, n_spares=1)
+    try:
+        assert wait_until(
+            lambda: reg.snapshot().get("router/backends_live") == 2.0
+            and reg.snapshot().get("router/spares_available") == 1.0
+        )
+        sessions = [_route(router, {"op": "attach"}) for _ in range(4)]
+        dead_addr = list(backends[0].address)
+        doomed = [s for s in sessions if s["addr"] == dead_addr]
+        assert doomed, "least-loaded attach must have used backend 0"
+        backends[0].close()
+        assert wait_until(
+            lambda: reg.snapshot().get("router/backends_dead") == 1.0,
+            timeout=10.0,
+        )
+        snap = reg.snapshot()
+        # promotion is a routing change only: the spare joined the pool
+        assert snap["router/spares_promoted_total"] == 1.0
+        assert snap["router/spares_available"] == 0.0
+        assert snap["router/backends_live"] == 2.0
+        assert snap["router/sessions_rehomed_total"] == float(len(doomed))
+        for s in doomed:
+            w = _route(router, {"op": "where", "session": s["session"]})
+            assert w["addr"] != dead_addr
+            assert w["epoch"] == 1 and w["rehomed"]
+        # survivors kept their home and epoch
+        for s in sessions:
+            if s in doomed:
+                continue
+            w = _route(router, {"op": "where", "session": s["session"]})
+            assert w["addr"] == s["addr"] and w["epoch"] == 0
+    finally:
+        router.close()
+        for b in backends:
+            b.close()
+
+
+def test_client_follows_router_redirect_after_backend_death():
+    """Fleet-mode client vs fake backends: the backend dies mid-game, the
+    next step rides the router's redirect to the survivor — one re-home,
+    one counted carry reset, zero client-visible errors."""
+    config, backends, reg, router = router_stack(
+        n_backends=2, request_deadline_s=10.0, request_retries=8
+    )
+    try:
+        assert wait_until(
+            lambda: reg.snapshot().get("router/backends_live") == 2.0
+        )
+        client = ServeClient(
+            *router.address, config, timeout_s=5.0, router=True
+        )
+        client.step(one_obs(config), reset=True)
+        home = list(client.backend_addr)
+        victim = next(
+            b for b in backends if list(b.address) == home
+        )
+        victim.close()
+        client.step(one_obs(config, seed=1))
+        assert client.rehomed_count == 1 and client.last_rehomed
+        assert list(client.backend_addr) != home
+        assert client.carry_resets == 1   # default mode: explicit reset
+        client.close()
+        assert wait_until(
+            lambda: reg.snapshot().get("router/sessions_rehomed_total")
+            >= 1.0
+        )
+    finally:
+        router.close()
+        for b in backends:
+            b.close()
+
+
+# -- quarantine composing with recovery (real serve stack) -------------------
+
+
+@pytest.mark.slow
+def test_quarantine_reclaims_slot_and_recovery_is_not_a_rehome():
+    """A quarantined client's slot is reclaimed; its retry path lands on a
+    fresh slot of the SAME (live) backend through the router — a counted
+    carry reset, but NOT a re-home (epoch unchanged)."""
+    config = tiny_config(
+        max_batch=1, batch_window_ms=0.0, max_slots=2,
+        request_deadline_s=30.0, request_retries=8,
+        router_probe_s=0.1, router_dead_after_s=0.4,
+    )
+    config = dataclasses.replace(
+        config,
+        transport=dataclasses.replace(
+            config.transport, poison_frame_limit=1
+        ),
+    )
+    reg = telemetry.Registry()
+    engine = make_engine(config, registry=reg)
+    server = PolicyServer(engine, config, port=0, registry=reg)
+    rreg = telemetry.Registry()
+    router = SessionRouter(config, [server.address], registry=rreg)
+    try:
+        assert wait_until(
+            lambda: rreg.snapshot().get("router/backends_live") == 1.0
+        )
+        client = ServeClient(
+            *router.address, config, timeout_s=5.0, router=True
+        )
+        client.step(one_obs(config), reset=True)
+        # poison the lane: one corrupt frame trips the limit and the
+        # server quarantines this connection (cut + slot reclaim)
+        client._sock.sendall(b"\xde\xad\xbe\xef" * 4)
+        assert wait_until(
+            lambda: reg.snapshot().get("transport/peers_quarantined")
+            == 1.0
+        )
+        # the probe conn holds one slot; ours was reclaimed — the next
+        # step reconnects onto a fresh slot and succeeds
+        client.step(one_obs(config, seed=1))
+        assert client.retries_total >= 1
+        assert client.carry_resets == 1
+        assert client.rehomed_count == 0   # same live backend: no re-home
+        snap = reg.snapshot()
+        assert snap["serve/slots_in_use"] == 2.0  # probe + this client
+        client.close()
+    finally:
+        router.close()
+        server.close()
+        engine.stop()
+
+
+# -- end-to-end failover on real engines -------------------------------------
+
+
+@pytest.mark.slow
+def test_rehome_on_real_backend_death_default_mode():
+    """Two real backends + spare behind the router; the client's home dies
+    mid-game. Default (no shadow) mode: the session re-homes onto the
+    promoted spare and resumes on an explicit counted carry reset."""
+    from dotaclient_tpu.models.policy import init_params as _init
+    import jax
+
+    from dotaclient_tpu.serve import make_inference_policy, ServeEngine
+
+    config = tiny_config(
+        max_batch=1, batch_window_ms=0.0, max_slots=4,
+        request_deadline_s=30.0, request_retries=16,
+        router_probe_s=0.1, router_dead_after_s=0.4,
+    )
+    policy = make_inference_policy(config)
+    params = _init(policy, jax.random.PRNGKey(0))
+    stacks = []
+    for _ in range(2):
+        reg = telemetry.Registry()
+        engine = ServeEngine(config, policy, params, registry=reg)
+        server = PolicyServer(engine, config, port=0, registry=reg)
+        stacks.append((reg, engine, server))
+    rreg = telemetry.Registry()
+    router = SessionRouter(
+        config, [stacks[0][2].address], spares=[stacks[1][2].address],
+        registry=rreg,
+    )
+    try:
+        assert wait_until(
+            lambda: rreg.snapshot().get("router/backends_live") == 1.0
+            and rreg.snapshot().get("router/spares_available") == 1.0
+        )
+        client = ServeClient(
+            *router.address, config, timeout_s=10.0, router=True
+        )
+        for i in range(3):
+            client.step(one_obs(config, seed=i), reset=(i == 0))
+        stacks[0][2].close()
+        stacks[0][1].stop()
+        for i in range(3, 6):
+            client.step(one_obs(config, seed=i))
+        assert client.rehomed_count == 1
+        assert client.carry_resets == 1
+        assert list(client.backend_addr) == list(stacks[1][2].address)
+        client.close()
+        snap = rreg.snapshot()
+        assert snap["router/spares_promoted_total"] == 1.0
+        assert snap["router/backend_deaths_total"] == 1.0
+        assert snap["router/sessions_rehomed_total"] >= 1.0
+    finally:
+        router.close()
+        for _reg, engine, server in stacks:
+            server.close()
+            engine.stop()
+
+
+@pytest.mark.slow
+def test_rehome_parity_digest_is_bitwise():
+    """The acceptance pin: the carry-shadow re-home resumes bit-exact,
+    proven by reference_step replay across the kill boundary, with the
+    teeth check keeping the proof honest."""
+    from scripts.serve_loadgen import run_rehome_parity
+
+    digest = run_rehome_parity(seed=0)
+    assert digest["parity"] == "bitwise", digest
+    assert digest["teeth"] is True
+    assert digest["mismatches"] == 0
+    assert digest["rehomed_sessions"] >= 1
+    assert digest["rehomed_to_spare"] is True
+
+
+# -- telemetry contract -------------------------------------------------------
+
+
+def test_require_router_schema_tier(tmp_path):
+    """A router process's JSONL satisfies --require-router at
+    construction — every key is eager-created, a zero-traffic router
+    still validates."""
+    from scripts.check_telemetry_schema import ROUTER_KEYS, validate_lines
+
+    config, backends, reg, router = router_stack(n_backends=1, n_spares=1)
+    try:
+        path = tmp_path / "router.jsonl"
+        sink = telemetry.JsonlSink(str(path))
+        sink.emit(0, reg.snapshot())
+        sink.close()
+        lines = path.read_text().splitlines()
+        errors = validate_lines(
+            lines, extra_required=ROUTER_KEYS, base_required=()
+        )
+        assert errors == [], errors
+    finally:
+        router.close()
+        for b in backends:
+            b.close()
